@@ -1,0 +1,39 @@
+"""apex_tpu — a TPU-native mixed-precision & distributed training framework.
+
+Brand-new implementation of the capabilities of NVIDIA Apex (reference
+snapshot surveyed in SURVEY.md), designed TPU-first:
+
+* bfloat16 mixed precision (``apex_tpu.amp``) — opt levels O0-O3 with
+  static-by-default loss scaling (bf16 has fp32's exponent range).
+* data parallelism over ``jax.sharding.Mesh`` with XLA collectives
+  (``apex_tpu.parallel``) — the DDP contract without buckets/streams.
+* fused optimizers (``apex_tpu.optimizers``) — whole-model single-program
+  updates (Adam, LAMB, NovoGrad, SGD) via XLA fusion + Pallas kernels.
+* fused normalization (``apex_tpu.normalization``) — Pallas LayerNorm.
+* multi-tensor engine (``apex_tpu.multi_tensor``) — pytree-wide scaled
+  copies / axpby / norms with a device-side overflow flag.
+* profiling (``apex_tpu.prof``) — named-scope capture + per-op flops/bytes
+  analysis of jaxprs (the pyprof analog).
+* legacy surfaces: ``bf16_utils`` (= reference fp16_utils), ``RNN``,
+  ``reparameterization``, ``contrib``.
+"""
+
+__version__ = "0.1.0"
+
+from . import amp            # noqa: F401
+from . import multi_tensor   # noqa: F401
+
+# Subpackages with heavier imports are lazy, mirroring the reference's lazy
+# optimizers/normalization imports (apex/__init__.py:1-19).
+import importlib as _importlib
+
+_LAZY = ("optimizers", "normalization", "parallel", "bf16_utils", "fp16_utils",
+         "RNN", "reparameterization", "contrib", "prof", "training", "models")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module 'apex_tpu' has no attribute {!r}".format(name))

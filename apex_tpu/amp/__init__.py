@@ -1,0 +1,21 @@
+"""apex_tpu.amp — automatic mixed precision for TPU (bf16-first).
+
+TPU-native re-design of ``apex/amp``; see SURVEY.md §2.1 for the component
+map.  Public surface mirrors the reference (``apex/amp/__init__.py``):
+``initialize``, ``scale_loss``, ``state_dict``/``load_state_dict``,
+``master_params``, the O1 registries and decorators, plus the functional
+pieces (``Policy`` casting helpers, jit-safe ``LossScaler``) that are the
+idiomatic JAX path.
+"""
+
+from .properties import Properties, opt_levels, AmpOptionError  # noqa: F401
+from .frontend import initialize, state_dict, load_state_dict   # noqa: F401
+from .handle import scale_loss, disable_casts, AmpHandle, NoOpHandle  # noqa: F401
+from .loss_scaler import LossScaler, LossScalerState, all_finite  # noqa: F401
+from ._amp_state import master_params, _amp_state  # noqa: F401
+from .policy import (applier, to_type, convert_params, wrap_forward,  # noqa: F401
+                     make_master, master_to_model, default_norm_predicate)
+from .autocast import (init, shutdown,  # noqa: F401
+                       register_half_function, register_float_function,
+                       register_promote_function, register_banned_function,
+                       half_function, float_function, promote_function)

@@ -1,0 +1,49 @@
+"""Global amp state singleton.
+
+Reference: ``apex/amp/_amp_state.py:17-70``.  Holds the active ``Properties``,
+the per-loss ``LossScaler`` list, verbosity, and the O1 handle.  Rank-0-aware
+printing uses ``jax.process_index()`` instead of the WORLD_SIZE env sniffing
+(reference ``:38-40``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        self.opt_properties = None
+        self.loss_scalers = []
+        self.handle = None
+        # O1 autocast: consulted by wrapped functions and apex_tpu layers.
+        self.autocast_enabled = False
+        self.autocast_dtype = None
+
+
+_amp_state = AmpState()
+
+
+def warn_or_err(msg):
+    if _amp_state.hard_override:
+        print("Warning: " + msg)
+    else:
+        raise RuntimeError(msg)
+
+
+def maybe_print(msg, rank0=False):
+    if _amp_state.verbosity > 0:
+        if not rank0 or jax.process_index() == 0:
+            print(msg)
+
+
+def master_params(optimizer):
+    """Generator over the fp32 master weights held by an amp-wired optimizer
+    (reference ``_amp_state.py:61-70``)."""
+    for leaf in jax.tree_util.tree_leaves(optimizer.master_params
+                                          if getattr(optimizer, "master_params", None)
+                                          is not None else optimizer.params):
+        yield leaf

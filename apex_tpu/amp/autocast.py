@@ -1,0 +1,281 @@
+"""O1 autocast engine: per-op cast policy, function registries, decorators.
+
+TPU-native re-design of the reference O1 patch engine
+(``apex/amp/amp.py:30-177``, ``apex/amp/wrap.py``, ``apex/amp/lists/``).
+
+The reference monkey-patches ``torch.*`` / ``torch.Tensor.*`` /
+``torch.nn.functional.*`` at ``amp.init()`` time.  The same mechanism works for
+``jax.numpy`` / ``jax.lax`` entry points — a wrapper that casts array
+arguments and calls the original is perfectly traceable under ``jit`` — with
+one documented caveat: the enabled flag is read at *trace* time, so toggling
+it (``disable_casts``) only affects functions traced afterwards.  That matches
+how jit-compiled training steps should consume amp anyway: decide the policy
+before compiling.
+
+Cast lists (reference ``apex/amp/lists/torch_overrides.py`` and
+``functional_overrides.py``) translated to the jnp/lax namespace:
+
+* half (bf16) list — MXU ops: matmul family and convolutions.
+* fp32 list — transcendentals, reductions, norms, losses, softmax.
+* promote list — binary ops whose operands must agree: jnp promotes
+  bf16+fp32→fp32 natively, so only ``cat``/``stack``-style sequence promotion
+  needs handling.
+* banned — none: ``binary_cross_entropy`` is banned in the reference because
+  fp16 logs overflow (``functional_overrides.py:59-70``); bf16 shares fp32's
+  range so the TPU policy runs it in fp32 instead of raising.  The banning
+  machinery exists (``err_if_banned``) for API parity and fp16 users.
+
+User registries keep the reference API verbatim: ``register_half_function``,
+``register_float_function``, ``register_promote_function`` and the decorator
+forms ``half_function`` / ``float_function`` / ``promote_function``
+(reference ``amp.py:30-64``).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ._amp_state import _amp_state, maybe_print
+
+
+def _is_float_array(x):
+    return hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+# -- weight-cast cache ---------------------------------------------------------
+# Reference utils.py:88-117: fp32->fp16 casts of *parameters* are cached so a
+# weight is cast once per step; the cache is cleared at scale_loss exit
+# (handle.py:153-155).  Under jit XLA CSEs duplicate casts, so the cache only
+# matters for eager use; keyed on array object identity.
+_cast_cache = {}
+
+
+def clear_cast_cache():
+    _cast_cache.clear()
+
+
+def cached_cast(dtype, x):
+    if not _is_float_array(x):
+        return x
+    if jnp.asarray(x).dtype == jnp.dtype(dtype):
+        return x
+    key = (id(x), jnp.dtype(dtype).name)
+    if key in _cast_cache:
+        return _cast_cache[key]
+    out = jnp.asarray(x).astype(dtype)
+    if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
+        _cast_cache[key] = out
+    return out
+
+
+def _cast_args(dtype, args, kwargs):
+    args = tuple(cached_cast(dtype, a) if _is_float_array(a)
+                 else (type(a)(cached_cast(dtype, x) if _is_float_array(x) else x for x in a)
+                       if isinstance(a, (list, tuple)) else a)
+                 for a in args)
+    kwargs = {k: cached_cast(dtype, v) if _is_float_array(v) else v
+              for k, v in kwargs.items()}
+    return args, kwargs
+
+
+# -- wrapper factories (reference wrap.py) ------------------------------------
+
+def make_cast_wrapper(orig_fn: Callable, cast_dtype, verbose_name=None):
+    """Wrap ``orig_fn`` so float array args are cast to ``cast_dtype`` when
+    autocast is enabled (reference ``wrap.py:10-29``)."""
+    name = verbose_name or getattr(orig_fn, "__name__", "fn")
+
+    @functools.wraps(orig_fn)
+    def wrapper(*args, **kwargs):
+        if not _amp_state.autocast_enabled:
+            return orig_fn(*args, **kwargs)
+        dtype = cast_dtype
+        if dtype == "half":
+            dtype = _amp_state.autocast_dtype or jnp.bfloat16
+        if _amp_state.verbosity >= 2:
+            maybe_print("amp: casting args of {} to {}".format(name, jnp.dtype(dtype).name))
+        cargs, ckwargs = _cast_args(dtype, args, kwargs)
+        return orig_fn(*cargs, **ckwargs)
+    wrapper.__amp_original__ = orig_fn
+    return wrapper
+
+
+def make_promote_wrapper(orig_fn: Callable):
+    """Promote all float args to the widest float dtype among them
+    (reference ``wrap.py:65-91``)."""
+    @functools.wraps(orig_fn)
+    def wrapper(*args, **kwargs):
+        if not _amp_state.autocast_enabled:
+            return orig_fn(*args, **kwargs)
+        floats = [jnp.asarray(a).dtype for a in _flat_arrays(args) if _is_float_array(a)]
+        if not floats:
+            return orig_fn(*args, **kwargs)
+        widest = functools.reduce(jnp.promote_types, floats)
+        cargs, ckwargs = _cast_args(widest, args, kwargs)
+        return orig_fn(*cargs, **ckwargs)
+    wrapper.__amp_original__ = orig_fn
+    return wrapper
+
+
+def _flat_arrays(args):
+    for a in args:
+        if isinstance(a, (list, tuple)):
+            for x in a:
+                yield x
+        else:
+            yield a
+
+
+def make_banned_wrapper(orig_fn: Callable, name: str):
+    """Raise on use under fp16 autocast unless allow_banned
+    (reference ``wrap.py:114-127`` / ``amp.py`` banned handling).  Under the
+    bf16 default policy the function is run in fp32 instead."""
+    @functools.wraps(orig_fn)
+    def wrapper(*args, **kwargs):
+        if _amp_state.autocast_enabled:
+            if _amp_state.autocast_dtype == jnp.float16 and not getattr(
+                    _amp_state, "allow_banned", False):
+                raise NotImplementedError(
+                    "amp does not work out-of-the-box with {} under float16 "
+                    "because it requires the full float range; use bfloat16, "
+                    "a safe replacement loss, or allow_banned=True.".format(name))
+            cargs, ckwargs = _cast_args(jnp.float32, args, kwargs)
+            return orig_fn(*cargs, **ckwargs)
+        return orig_fn(*args, **kwargs)
+    wrapper.__amp_original__ = orig_fn
+    return wrapper
+
+
+# -- cast lists ---------------------------------------------------------------
+# (module, attribute-name) pairs; resolved lazily at init() so wrapping is
+# reversible and import order does not matter.
+
+import jax.lax as lax  # noqa: E402
+import jax.nn as jnn   # noqa: E402
+
+# MXU ops -> half (reference torch_overrides.py FP16_FUNCS: conv*/BLAS).
+_HALF_LIST = [
+    (jnp, "dot"), (jnp, "matmul"), (jnp, "vdot"), (jnp, "inner"),
+    (jnp, "outer"), (jnp, "tensordot"), (jnp, "einsum"),
+    (lax, "dot"), (lax, "dot_general"),
+    (lax, "conv"), (lax, "conv_general_dilated"), (lax, "conv_transpose"),
+]
+
+# Transcendentals / reductions / norms -> fp32
+# (reference torch_overrides.py FP32_FUNCS + functional_overrides FP32).
+_FP32_LIST = [
+    (jnp, "exp"), (jnp, "expm1"), (jnp, "log"), (jnp, "log1p"), (jnp, "log2"),
+    (jnp, "log10"), (jnp, "cosh"), (jnp, "sinh"), (jnp, "tan"),
+    (jnp, "power"), (jnp, "float_power"),
+    (jnp, "sum"), (jnp, "prod"), (jnp, "cumsum"), (jnp, "cumprod"),
+    (jnp, "var"), (jnp, "std"), (jnp, "mean"),
+    (jnn, "softmax"), (jnn, "log_softmax"), (jnn, "logsumexp"),
+    (jnn, "standardize"),
+]
+
+# Sequence promotion (reference SEQUENCE_CASTS = cat/stack).
+_PROMOTE_LIST = [
+    (jnp, "concatenate"), (jnp, "stack"), (jnp, "hstack"), (jnp, "vstack"),
+    (jnp, "where"),
+]
+
+_BANNED_LIST = []  # populated for fp16 policies via register_banned_function
+
+_patched = []  # (module, name, original)
+
+
+def init(enabled=True, verbose=False, allow_banned=False, half_dtype=jnp.bfloat16):
+    """Enable the O1 autocast policy and patch the jnp/lax cast lists.
+
+    Reference ``apex/amp/amp.py:68-177`` (``amp.init``).  Idempotent.
+    """
+    _amp_state.autocast_enabled = enabled
+    _amp_state.autocast_dtype = half_dtype
+    _amp_state.allow_banned = allow_banned
+    if verbose:
+        _amp_state.verbosity = 2
+    if _patched:
+        return
+    for mod, name in _HALF_LIST:
+        orig = getattr(mod, name)
+        setattr(mod, name, make_cast_wrapper(orig, "half", name))
+        _patched.append((mod, name, orig))
+    for mod, name in _FP32_LIST:
+        orig = getattr(mod, name)
+        setattr(mod, name, make_cast_wrapper(orig, jnp.float32, name))
+        _patched.append((mod, name, orig))
+    for mod, name in _PROMOTE_LIST:
+        orig = getattr(mod, name)
+        setattr(mod, name, make_promote_wrapper(orig))
+        _patched.append((mod, name, orig))
+    for mod, name in _BANNED_LIST:
+        orig = getattr(mod, name)
+        setattr(mod, name, make_banned_wrapper(orig, name))
+        _patched.append((mod, name, orig))
+
+
+def shutdown():
+    """Undo ``init``: restore originals and disable the policy."""
+    _amp_state.autocast_enabled = False
+    while _patched:
+        mod, name, orig = _patched.pop()
+        setattr(mod, name, orig)
+
+
+class disable_casts:
+    """Context manager disabling the autocast policy (reference
+    ``handle.py:160-164``).  Trace-time only — see module docstring."""
+    def __enter__(self):
+        self._saved = _amp_state.autocast_enabled
+        _amp_state.autocast_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _amp_state.autocast_enabled = self._saved
+        return False
+
+
+# -- user registries ----------------------------------------------------------
+
+def register_half_function(module, name):
+    """Wrap ``module.name`` to run in the half dtype under autocast
+    (reference ``amp.py:46-51``)."""
+    orig = getattr(module, name)
+    setattr(module, name, make_cast_wrapper(orig, "half", name))
+    _patched.append((module, name, orig))
+
+
+def register_float_function(module, name):
+    orig = getattr(module, name)
+    setattr(module, name, make_cast_wrapper(orig, jnp.float32, name))
+    _patched.append((module, name, orig))
+
+
+def register_promote_function(module, name):
+    orig = getattr(module, name)
+    setattr(module, name, make_promote_wrapper(orig))
+    _patched.append((module, name, orig))
+
+
+def register_banned_function(module, name):
+    orig = getattr(module, name)
+    setattr(module, name, make_banned_wrapper(orig, name))
+    _patched.append((module, name, orig))
+
+
+# Decorator forms (reference amp.py:30-42).
+def half_function(fn):
+    return make_cast_wrapper(fn, "half")
+
+
+def float_function(fn):
+    return make_cast_wrapper(fn, jnp.float32)
+
+
+def promote_function(fn):
+    return make_promote_wrapper(fn)

@@ -1,0 +1,162 @@
+"""``amp.initialize`` and amp checkpoint state.
+
+TPU-native re-design of reference ``apex/amp/frontend.py:195-400`` +
+``apex/amp/_initialize.py``.  In JAX a "model" is a parameter pytree (plus a
+pure apply function), so ``initialize`` consumes and returns *param trees* and
+``apex_tpu.optimizers`` objects rather than mutating modules in place:
+
+* O2/O3: params are cast to bf16 (keep-batchnorm-fp32 honored via path
+  heuristics — ``policy.convert_params``), the optimizer is wired with fp32
+  master weights, and the returned params are the *model* (bf16) copy.
+* O1: the autocast policy over jnp/lax is enabled (``autocast.init``),
+  params stay fp32.
+* O0: everything fp32, loss scale 1.0.
+
+``state_dict``/``load_state_dict`` serialize every loss scaler's
+``loss_scale`` and ``unskipped`` exactly like reference
+``frontend.py:361-400``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import autocast
+from ._amp_state import _amp_state, maybe_print, warn_or_err
+from .loss_scaler import LossScaler
+from .policy import convert_params, wrap_forward  # noqa: F401  (re-exported)
+from .properties import AmpOptionError, Properties, opt_levels
+
+
+def initialize(models=None,
+               optimizers=None,
+               enabled: bool = True,
+               opt_level: str = "O1",
+               cast_model_type=None,
+               patch_functions=None,
+               keep_batchnorm_fp32=None,
+               master_weights=None,
+               loss_scale=None,
+               cast_model_outputs=None,
+               num_losses: int = 1,
+               verbosity: int = 1,
+               min_loss_scale=None,
+               max_loss_scale: float = 2.**24,
+               norm_predicate=None):
+    """Initialize mixed precision.  Returns ``(models, optimizers)`` shaped
+    like the inputs (single objects in → single objects out, reference
+    ``_initialize.py:245-260``).
+
+    ``models`` are parameter pytrees (or a list of them); ``optimizers`` are
+    ``apex_tpu.optimizers`` instances (or a list).  Either may be None.
+    """
+    _amp_state.verbosity = verbosity
+
+    if not enabled:
+        _amp_state.opt_properties = Properties()
+        return _unlistify(models, optimizers)
+
+    if opt_level not in opt_levels:
+        raise AmpOptionError(
+            "Unexpected optimization level {!r}; options are 'O0', 'O1', "
+            "'O2', 'O3'. Note the 'O' is the letter O.".format(opt_level))
+
+    properties = opt_levels[opt_level]()
+    maybe_print("apex_tpu.amp: opt_level {}".format(opt_level), True)
+
+    overrides = dict(cast_model_type=cast_model_type,
+                     patch_functions=patch_functions,
+                     keep_batchnorm_fp32=keep_batchnorm_fp32,
+                     master_weights=master_weights,
+                     loss_scale=loss_scale,
+                     cast_model_outputs=cast_model_outputs)
+    for k, v in overrides.items():
+        if v is not None:
+            setattr(properties, k, v)
+    _amp_state.opt_properties = properties
+
+    # Loss scalers, one per loss (reference _initialize.py:224-228).
+    _amp_state.loss_scalers = [
+        LossScaler(properties.loss_scale,
+                   min_loss_scale=min_loss_scale,
+                   max_loss_scale=max_loss_scale)
+        for _ in range(num_losses)
+    ]
+
+    models_was_list = isinstance(models, (list, tuple))
+    optimizers_was_list = isinstance(optimizers, (list, tuple))
+    model_list = list(models) if models_was_list else ([models] if models is not None else [])
+    opt_list = list(optimizers) if optimizers_was_list else ([optimizers] if optimizers is not None else [])
+
+    for opt in opt_list:
+        if getattr(opt, "_amp_wired", False):
+            warn_or_err("An optimizer was passed to amp.initialize twice; "
+                        "call initialize once with all models and optimizers.")
+
+    # O2/O3: whole-model cast (reference _initialize.py:173-179 via
+    # convert_network / .to(dtype)).
+    cast_type = properties.cast_model_type
+    if cast_type is not None and jnp.dtype(cast_type) != jnp.dtype(jnp.float32):
+        keep_bn = properties.keep_batchnorm_fp32
+        keep_bn = True if keep_bn is None else keep_bn
+        model_list = [convert_params(m, cast_type, keep_norm_fp32=keep_bn,
+                                     norm_predicate=norm_predicate)
+                      for m in model_list]
+
+    # O1: enable the jnp/lax autocast policy (reference _initialize.py:230-243
+    # calling amp.init()).
+    if properties.patch_functions:
+        autocast.init(enabled=True, verbose=(verbosity >= 2))
+    else:
+        _amp_state.autocast_enabled = False
+
+    # Wire optimizers: master weights + loss scaler handshake
+    # (reference _process_optimizer.py injected methods).
+    for i, opt in enumerate(opt_list):
+        scaler = _amp_state.loss_scalers[min(i, num_losses - 1)]
+        if hasattr(opt, "_amp_wire"):
+            new_params = model_list[i] if i < len(model_list) else None
+            opt._amp_wire(properties, scaler, cast_params=new_params)
+
+    return _unlistify(model_list if models is not None else None,
+                      opt_list if optimizers is not None else None,
+                      models_was_list, optimizers_was_list,
+                      models is not None, optimizers is not None)
+
+
+def _unlistify(models, optimizers, models_was_list=False, optimizers_was_list=False,
+               had_models=True, had_optimizers=True):
+    m = models if models_was_list else (models[0] if isinstance(models, list) and models else models)
+    o = optimizers if optimizers_was_list else (optimizers[0] if isinstance(optimizers, list) and optimizers else optimizers)
+    if had_models and had_optimizers:
+        return m, o
+    if had_models:
+        return m
+    if had_optimizers:
+        return o
+    return None
+
+
+def state_dict(destination=None):
+    """Serialize amp state: one entry per loss scaler
+    (reference ``frontend.py:361-370``)."""
+    if destination is None:
+        destination = {}
+    for idx, ls in enumerate(_amp_state.loss_scalers):
+        destination["loss_scaler%d" % idx] = ls.state_dict()
+    return destination
+
+
+def load_state_dict(sd):
+    """Restore amp state (reference ``frontend.py:373-400``), warning on
+    scaler-count mismatch like the reference."""
+    n_src, n_dst = len(sd), len(_amp_state.loss_scalers)
+    if n_src != n_dst:
+        print("Warning: state dict has {} loss scalers, amp has {}; loading "
+              "the overlap.".format(n_src, n_dst))
+    for idx, ls in enumerate(_amp_state.loss_scalers):
+        key = "loss_scaler%d" % idx
+        if key in sd:
+            ls.load_state_dict(sd[key])

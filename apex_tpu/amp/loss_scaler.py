@@ -1,0 +1,221 @@
+"""Loss scaling: static and dynamic, as jit-safe functional state.
+
+TPU-native re-design of the reference scaler (``apex/amp/scaler.py:33-217``).
+The semantics preserved exactly:
+
+* dynamic: init 2**16 (capped by ``max_loss_scale`` default 2**24), doubled
+  every ``scale_window`` (2000) clean steps, halved on overflow, optional
+  ``min_loss_scale`` floor (reference ``scaler.py:38-56, 197-217``).
+* ``unscale`` divides grads by the scale and raises a *device-side* overflow
+  flag if any grad is non-finite (reference multi_tensor_scale writes a GPU
+  int buffer; here the flag is a traced jnp scalar — zero host syncs unless
+  the caller asks for one).
+* per-loss scalers (``num_losses``/``loss_id``) and ``state_dict`` fields
+  ``loss_scale`` + ``unskipped`` round-trip (reference ``frontend.py:361-400``).
+
+TPU-first difference: because the default half type is bfloat16 (fp32 exponent
+range), the default loss scale is **static 1.0** — the whole state machine then
+compiles away to a no-op.  The dynamic machine is fully functional for fp16
+users and for checkpoint parity.
+
+The class is registered as a pytree so a ``LossScalerState`` can live inside a
+jitted train step: ``update_scale`` is pure (returns a new state) and the
+"skip step" decision is a traced boolean the optimizer consumes as a mask —
+no data-dependent Python control flow (reference ``handle.py:126-151`` patches
+``optimizer.step``; the TPU equivalent is a select, see
+``apex_tpu/optimizers``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import multi_tensor as mta
+
+
+class LossScalerState(NamedTuple):
+    """Traced state of one loss scaler (a valid jit carry)."""
+    loss_scale: jnp.ndarray      # f32 scalar
+    unskipped: jnp.ndarray       # i32 scalar — clean steps since last overflow
+    overflow: jnp.ndarray        # bool scalar — overflow seen this step
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Device-side AND-reduction of isfinite over a grad tree (no host sync)."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))
+
+
+class LossScaler:
+    """Static or dynamic loss scaler.
+
+    Functional usage (the idiomatic path — everything stays on device)::
+
+        scaler = LossScaler("dynamic")
+        state = scaler.init()
+        ...inside jit...
+        loss = scaler.scale_loss(loss, state)
+        grads, state = scaler.unscale(grads, state)   # sets state.overflow
+        state = scaler.update_scale(state)            # adjust scale, reset flag
+        # optimizer consumes state.overflow as a skip mask
+
+    Imperative usage (API parity with the reference) keeps an internal state
+    and exposes ``loss_scale()`` / ``update_scale()`` like
+    ``apex/amp/scaler.py``.
+    """
+
+    warned_unscaling_non_fp32_grad = False
+
+    def __init__(self,
+                 loss_scale,
+                 init_scale=2.**16,
+                 scale_factor=2.,
+                 scale_window=2000,
+                 min_loss_scale=None,
+                 max_loss_scale=2.**24):
+        if loss_scale == "dynamic":
+            self.dynamic = True
+            self._initial_scale = min(max_loss_scale, init_scale)
+        else:
+            self.dynamic = False
+            self._initial_scale = float(loss_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._min_loss_scale = min_loss_scale
+        self._max_loss_scale = max_loss_scale
+        self._state = self.init()
+
+    # -- functional core -----------------------------------------------------
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.float32(self._initial_scale),
+            unskipped=jnp.int32(0),
+            overflow=jnp.asarray(False),
+        )
+
+    def scale_loss(self, loss, state: LossScalerState = None):
+        state = self._state if state is None else state
+        if not self.dynamic and self._initial_scale == 1.0:
+            return loss  # fast path, reference handle.py:93-102
+        return jnp.asarray(loss, jnp.float32) * state.loss_scale
+
+    def unscale(self, grads, state: LossScalerState = None, *, scale=None):
+        """Divide grads by the scale; record overflow in the returned state.
+
+        Equivalent of ``LossScaler.unscale`` → multi_tensor_scale with the
+        device-side noop flag (reference ``scaler.py:57-117``).  Grads are
+        unscaled in fp32 (master-grad dtype).
+        """
+        explicit = state is not None
+        state = self._state if state is None else state
+        s = state.loss_scale if scale is None else scale
+        out, overflow = mta.multi_tensor_scale(grads, 1.0 / s,
+                                               out_dtype=jnp.float32)
+        if self.dynamic:
+            new_state = state._replace(overflow=jnp.logical_or(state.overflow, overflow))
+        else:
+            new_state = state
+        if not explicit:
+            self._state = new_state
+        return out, new_state
+
+    def unscale_with_stashed(self, new_grads, stashed_grads,
+                             state: LossScalerState = None, *, scale=None):
+        """Gradient accumulation: out = new/scale + stashed, overflow-checked.
+
+        Equivalent of the fused axpby path (reference ``scaler.py:152-189``).
+        """
+        explicit = state is not None
+        state = self._state if state is None else state
+        s = state.loss_scale if scale is None else scale
+        out, overflow = mta.multi_tensor_axpby(new_grads, stashed_grads,
+                                               1.0 / s, 1.0,
+                                               out_dtype=jnp.float32)
+        if self.dynamic:
+            new_state = state._replace(overflow=jnp.logical_or(state.overflow, overflow))
+        else:
+            new_state = state
+        if not explicit:
+            self._state = new_state
+        return out, new_state
+
+    def clear_overflow_state(self, state: LossScalerState = None):
+        explicit = state is not None
+        state = self._state if state is None else state
+        new_state = state._replace(overflow=jnp.asarray(False))
+        if not explicit:
+            self._state = new_state
+        return new_state
+
+    def update_scale(self, state: LossScalerState = None):
+        """Adjust the scale from the overflow flag; pure and traceable.
+
+        Reference ``scaler.py:197-217``: on overflow, scale/2 (clamped at
+        ``min_loss_scale``) and reset the window; every ``scale_window`` clean
+        steps, scale*2 (clamped at ``max_loss_scale``).
+        """
+        explicit = state is not None
+        state = self._state if state is None else state
+        if not self.dynamic:
+            new_state = state._replace(overflow=jnp.asarray(False))
+            if not explicit:
+                self._state = new_state
+            return new_state
+
+        overflow = state.overflow
+        shrunk = state.loss_scale / self._scale_factor
+        if self._min_loss_scale is not None:
+            shrunk = jnp.maximum(shrunk, self._min_loss_scale)
+        window_full = (state.unskipped + 1) >= self._scale_window
+        grown = jnp.minimum(state.loss_scale * self._scale_factor,
+                            self._max_loss_scale)
+        new_scale = jnp.where(
+            overflow, shrunk, jnp.where(window_full, grown, state.loss_scale))
+        new_unskipped = jnp.where(
+            jnp.logical_or(overflow, window_full), 0, state.unskipped + 1)
+        new_state = LossScalerState(
+            loss_scale=new_scale.astype(jnp.float32),
+            unskipped=new_unskipped.astype(jnp.int32),
+            overflow=jnp.asarray(False),
+        )
+        if not explicit:
+            self._state = new_state
+        return new_state
+
+    # -- imperative / checkpoint API (reference parity) ----------------------
+    def loss_scale(self):
+        return float(jax.device_get(self._state.loss_scale))
+
+    def update_scale_sync(self) -> bool:
+        """Imperative update: ONE host sync per step, like the reference's
+        ``overflow_buf.item()`` (``scaler.py:199-200``).  Returns
+        ``should_skip`` for the step-skipping contract."""
+        should_skip = bool(jax.device_get(self._state.overflow)) and self.dynamic
+        self._state = self.update_scale(self._state)
+        return should_skip
+
+    @property
+    def state(self) -> LossScalerState:
+        return self._state
+
+    @state.setter
+    def state(self, s: LossScalerState):
+        self._state = s
+
+    def state_dict(self):
+        """Reference serializes ``loss_scale`` + ``unskipped``
+        (``frontend.py:361-370``)."""
+        return {"loss_scale": float(jax.device_get(self._state.loss_scale)),
+                "unskipped": int(jax.device_get(self._state.unskipped))}
+
+    def load_state_dict(self, sd):
+        self._state = LossScalerState(
+            loss_scale=jnp.float32(sd["loss_scale"]),
+            unskipped=jnp.int32(sd["unskipped"]),
+            overflow=jnp.asarray(False))

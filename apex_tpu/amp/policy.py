@@ -1,0 +1,148 @@
+"""Dtype policies and recursive casting utilities.
+
+TPU-native analog of the reference's input/model casting machinery:
+
+* ``applier`` — recursive caster over nested containers
+  (reference ``apex/amp/_initialize.py:35-57``).
+* model conversion with keep-batchnorm-fp32
+  (reference ``apex/fp16_utils/fp16util.py:74-86`` used by O2).
+* patched-forward input/output casting (reference ``_initialize.py:181-219``)
+  becomes :func:`wrap_forward`, a pure function wrapper that casts inputs to the
+  compute dtype and outputs back to fp32 — jit-traceable, no monkey patching.
+
+In JAX, parameters are pytrees, so "convert the network" is a pytree map with a
+per-leaf dtype rule.  Normalization-scale parameters are detected by path name
+(``scale``/``bias`` under a ``*Norm``/``bn`` collection — flax convention) so
+keep_batchnorm_fp32 works for flax models out of the box; users can pass a
+custom predicate for exotic layouts.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Leaves considered "floating" for casting purposes.  Integer/bool leaves
+# (embedding ids, masks, rng keys) always pass through untouched.
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def applier(value: Any, fn: Callable[[Any], Any]) -> Any:
+    """Recursively apply ``fn`` to every array leaf of ``value``.
+
+    Mirrors reference ``_initialize.py:35-57`` (which walks
+    strings/mappings/iterables and respects custom ``.to()``); here the pytree
+    protocol already covers dicts/lists/tuples/custom nodes, so this is
+    ``jax.tree_util.tree_map`` with non-array leaves passed through.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: fn(x) if hasattr(x, "dtype") else x, value)
+
+
+def to_type(dtype, value):
+    """Cast every *floating* array leaf of ``value`` to ``dtype``.
+
+    Reference ``_initialize.py:17-32`` warns when an input is not fp32;
+    integer leaves are left alone for the same reason (indices stay indices).
+    """
+    def cast(x):
+        return x.astype(dtype) if _is_float(x) else x
+    return applier(value, cast)
+
+
+# -- keep-batchnorm-fp32 model conversion ------------------------------------
+
+# Flax linen convention: BatchNorm/LayerNorm/GroupNorm parameters live under a
+# module path containing one of these markers.  ``convert_params`` keeps any
+# matching leaf in fp32 when keep_norm_fp32 is set.
+_NORM_PATH_RE = re.compile(r"(?:^|[/._])(?:bn|batchnorm|batch_norm|norm|ln|layernorm|"
+                           r"layer_norm|groupnorm|group_norm|batch_stats)(?:$|[/._\d])",
+                           re.IGNORECASE)
+
+
+def default_norm_predicate(path: str) -> bool:
+    """True if a parameter path looks like it belongs to a normalization layer."""
+    return bool(_NORM_PATH_RE.search(path))
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def convert_params(params,
+                   dtype,
+                   keep_norm_fp32: bool = True,
+                   norm_predicate: Optional[Callable[[str], bool]] = None):
+    """Cast a parameter pytree to ``dtype``, optionally keeping norm params fp32.
+
+    TPU-native equivalent of ``convert_network`` (reference
+    ``apex/fp16_utils/fp16util.py:74-86``): walk the module tree, convert
+    every float leaf, but skip affine BatchNorm parameters so their small
+    per-channel scale/shift math stays in fp32.
+    """
+    if norm_predicate is None:
+        norm_predicate = default_norm_predicate
+
+    def cast(path, x):
+        if not _is_float(x):
+            return x
+        if keep_norm_fp32 and norm_predicate(_path_str(path)):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def wrap_forward(apply_fn: Callable,
+                 cast_input_type=None,
+                 cast_output_type=jnp.float32) -> Callable:
+    """Wrap a model apply function so inputs are cast to the compute dtype and
+    outputs back to fp32 (or ``cast_output_type``).
+
+    Reference behavior: O2/O3 patch ``model.forward`` to cast ``*args``
+    /``**kwargs`` to ``cast_model_type`` and outputs to fp32 /
+    ``cast_model_outputs`` (``_initialize.py:181-219``).  Here the wrapper is a
+    pure function — safe under jit, grad, vmap, shard_map.
+    """
+    def wrapped(*args, **kwargs):
+        if cast_input_type is not None:
+            args = to_type(cast_input_type, args)
+            kwargs = to_type(cast_input_type, kwargs)
+        out = apply_fn(*args, **kwargs)
+        if cast_output_type is not None:
+            out = to_type(cast_output_type, out)
+        return out
+    return wrapped
+
+
+# -- master weights ----------------------------------------------------------
+
+def make_master(params):
+    """fp32 master copy of a (possibly reduced-precision) parameter tree.
+
+    Reference: ``param.detach().clone().float()``
+    (``apex/amp/_process_optimizer.py:43-51``).
+    """
+    return applier(params, lambda x: x.astype(jnp.float32) if _is_float(x) else x)
+
+
+def master_to_model(master_params, model_params):
+    """Cast fp32 masters back onto the model's dtypes (the post-step copy,
+    reference ``_process_optimizer.py:345-356`` via multi_tensor_scale(1.0))."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype) if _is_float(p) else m,
+        master_params, model_params)

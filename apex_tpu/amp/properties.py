@@ -1,0 +1,202 @@
+"""Opt-level frontend: the ``Properties`` option struct and the O0-O3 presets.
+
+TPU-native re-design of the reference's opt-level state machine
+(``apex/amp/frontend.py:7-191``).  Semantics preserved:
+
+* ``Properties`` validates every attribute assignment and cross-checks
+  incompatible combinations (reference ``frontend.py:31-97``).
+* ``O0``..``O3`` are preset objects; ``amp.initialize`` starts from a preset and
+  applies user overrides on top (reference ``frontend.py:102-191``).
+
+TPU-first differences (deliberate, not omissions):
+
+* The half type is **bfloat16**, not float16.  bf16 shares float32's exponent
+  range, so *static* loss scaling (scale=1) is numerically safe and is the
+  default for every opt level; the full dynamic-scaler state machine is retained
+  for API/checkpoint parity and for users who opt into float16.
+* ``patch_torch_functions`` becomes ``patch_functions``: O1 on TPU is a dtype
+  *policy* consulted by ``apex_tpu`` ops and user-registered functions (see
+  ``apex_tpu/amp/autocast.py``) rather than runtime monkey-patching, which is
+  hostile to ``jax.jit`` tracing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class AmpOptionError(ValueError):
+    pass
+
+
+_DTYPE_NAMES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+}
+
+
+def _canonical_dtype(value):
+    """Accept jnp dtypes, numpy dtypes or string names; return a jnp dtype or None."""
+    if value is None or value is False:
+        return None
+    if isinstance(value, str):
+        try:
+            return _DTYPE_NAMES[value.lower()]
+        except KeyError:
+            raise AmpOptionError(
+                "Unsupported cast type {!r}; expected one of {}".format(
+                    value, sorted(_DTYPE_NAMES)))
+    return jnp.dtype(value).type
+
+
+class Properties:
+    """Mutable option struct with consistency checking on every assignment.
+
+    Mirrors reference ``apex/amp/frontend.py:7-97``: unknown options raise,
+    and a handful of combinations are rejected eagerly so failures are timely
+    rather than appearing as silent misbehavior mid-training.
+    """
+
+    _FIELDS = (
+        "enabled",
+        "opt_level",
+        "cast_model_type",
+        "patch_functions",
+        "keep_batchnorm_fp32",
+        "master_weights",
+        "loss_scale",
+        "cast_model_outputs",
+    )
+
+    def __init__(self):
+        self.__dict__["options"] = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "patch_functions": False,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+            "cast_model_outputs": None,
+        }
+
+    # -- access -------------------------------------------------------------
+    def _update_options_dict(self, new_options):
+        for k, v in new_options.items():
+            if k in self.options:
+                setattr(self, k, v)
+            else:
+                raise AmpOptionError("Tried to set unexpected option {!r}".format(k))
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__ and name in self.__dict__["options"]:
+            return self.__dict__["options"][name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name not in self.__dict__.get("options", {}):
+            raise AmpOptionError("Tried to set unexpected option {!r}".format(name))
+        if name == "cast_model_type":
+            value = _canonical_dtype(value)
+            if self.opt_level == "O1" and value is not None:
+                raise AmpOptionError(
+                    "O1 inserts casts around individual ops rather than casting the "
+                    "model; cast_model_type is not allowed with opt_level O1.")
+        elif name == "patch_functions":
+            if value and self.opt_level in ("O2", "O3"):
+                raise AmpOptionError(
+                    "patch_functions (the O1 autocast policy) cannot be combined "
+                    "with a whole-model cast (O2/O3).")
+        elif name == "keep_batchnorm_fp32":
+            if isinstance(value, str):
+                if value.lower() not in ("true", "false"):
+                    raise AmpOptionError(
+                        "keep_batchnorm_fp32 must be a bool or the strings "
+                        "'True'/'False', got {!r}".format(value))
+                value = value.lower() == "true"
+            if value is not None and not isinstance(value, bool):
+                raise AmpOptionError(
+                    "keep_batchnorm_fp32 must be a bool, a 'True'/'False' string, "
+                    "or None, got {!r}".format(value))
+        elif name == "loss_scale":
+            if value != "dynamic" and value is not None:
+                value = float(value)
+                if value <= 0.0:
+                    raise AmpOptionError("loss_scale must be positive")
+        elif name == "cast_model_outputs":
+            value = _canonical_dtype(value)
+        self.__dict__["options"][name] = value
+
+    def __repr__(self):
+        return "Properties({})".format(
+            ", ".join("{}={!r}".format(k, v) for k, v in self.options.items()))
+
+    # Convenience predicates used throughout the package.
+    @property
+    def half_dtype(self):
+        """The reduced-precision dtype in play (cast_model_type for O2/O3,
+        bfloat16 for the O1 policy), or None for O0."""
+        if self.cast_model_type is not None:
+            return self.cast_model_type
+        if self.patch_functions:
+            return jnp.bfloat16
+        return None
+
+
+def _make_preset(name, doc, **opts):
+    def build():
+        p = Properties()
+        p.__dict__["options"]["enabled"] = True
+        p.__dict__["options"]["opt_level"] = name
+        for k, v in opts.items():
+            setattr(p, k, v)
+        return p
+    build.__name__ = name
+    build.__doc__ = doc
+    return build
+
+
+# Presets (reference frontend.py:102-191).  Note bf16 + static scale defaults.
+O3 = _make_preset(
+    "O3", "Pure reduced precision (bf16). Fast but no fp32 batchnorm safety net.",
+    cast_model_type=jnp.bfloat16,
+    patch_functions=False,
+    keep_batchnorm_fp32=False,
+    master_weights=False,
+    loss_scale=1.0,
+)
+
+O2 = _make_preset(
+    "O2", "'Almost bf16' mixed precision: bf16 model with fp32 batchnorm, "
+          "fp32 master weights, static loss scale 1.0 (dynamic on request).",
+    cast_model_type=jnp.bfloat16,
+    patch_functions=False,
+    keep_batchnorm_fp32=True,
+    master_weights=True,
+    loss_scale=1.0,
+)
+
+O1 = _make_preset(
+    "O1", "Insert casts per-op via the autocast policy: matmul/conv run bf16, "
+          "reductions and losses run fp32. Model weights stay fp32.",
+    cast_model_type=None,
+    patch_functions=True,
+    keep_batchnorm_fp32=None,
+    master_weights=False,
+    loss_scale=1.0,
+)
+
+O0 = _make_preset(
+    "O0", "Pure fp32 baseline.",
+    cast_model_type=jnp.float32,
+    patch_functions=False,
+    keep_batchnorm_fp32=None,
+    master_weights=False,
+    loss_scale=1.0,
+)
+
+opt_levels = {"O3": O3, "O2": O2, "O1": O1, "O0": O0}

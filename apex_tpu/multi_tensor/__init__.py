@@ -1,0 +1,167 @@
+"""Multi-tensor engine: whole-model fused elementwise ops with a device-side
+overflow flag.
+
+TPU-native re-design of the reference's ``amp_C`` multi-tensor-apply stack
+(``csrc/multi_tensor_apply.cuh``, ``csrc/multi_tensor_*_kernel.cu`` and the
+Python shim ``apex/multi_tensor_apply/multi_tensor_apply.py``).
+
+On CUDA the problem is *launch overhead*: updating N parameter tensors costs N
+kernel launches, so apex packs chunk pointers into one kernel argument struct.
+On TPU under XLA the launch problem dissolves — a jitted function over a whole
+parameter pytree compiles to one fused program.  What must be preserved is the
+*capability*:
+
+* operate on every tensor of a model in O(1) dispatches,
+* carry a **device-side** overflow flag (no host sync on the hot path),
+* honor mixed in/out dtypes (bf16 grads → fp32 masters etc.).
+
+Each op here is a pure function over pytrees, safe under jit/grad/shard_map,
+plus a thin ``multi_tensor_applier`` shim for reference API parity
+(``apex/multi_tensor_apply/multi_tensor_apply.py:3-30``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm",
+    "multi_tensor_maxnorm", "tree_finite", "MultiTensorApply",
+    "multi_tensor_applier", "flatten", "unflatten",
+]
+
+
+def _float_leaves(tree):
+    return [x for x in jax.tree_util.tree_leaves(tree)
+            if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+
+
+def tree_finite(tree) -> jnp.ndarray:
+    """Device-side bool: every float leaf of ``tree`` is finite."""
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))
+
+
+def multi_tensor_scale(tree, scale, out_dtype=None) -> Tuple[Any, jnp.ndarray]:
+    """``out = in * scale`` over every float leaf; returns (out, overflow).
+
+    Equivalent of ``amp_C.multi_tensor_scale`` (``csrc/
+    multi_tensor_scale_kernel.cu:18-77``): the scaled value is checked for
+    finiteness and a device-side flag raised on inf/NaN.  Used for loss
+    unscaling and master<->model copies (scale=1.0).
+    """
+    def one(x):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)):
+            return x
+        y = jnp.asarray(x, jnp.float32) * scale
+        return y.astype(out_dtype or jnp.asarray(x).dtype)
+    out = jax.tree_util.tree_map(one, tree)
+    return out, jnp.logical_not(tree_finite(out))
+
+
+def multi_tensor_axpby(x_tree, y_tree, a, b, out_dtype=None) -> Tuple[Any, jnp.ndarray]:
+    """``out = a*x + b*y`` leafwise, overflow-checked.
+
+    Equivalent of ``amp_C.multi_tensor_axpby``
+    (``csrc/multi_tensor_axpby_kernel.cu:16-90``) — the gradient-accumulation
+    unscale (new_grad/scale + stashed_grad).
+    """
+    def one(x, y):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)):
+            return x
+        out = a * jnp.asarray(x, jnp.float32) + b * jnp.asarray(y, jnp.float32)
+        return out.astype(out_dtype or jnp.asarray(x).dtype)
+    out = jax.tree_util.tree_map(one, x_tree, y_tree)
+    return out, jnp.logical_not(tree_finite(out))
+
+
+def multi_tensor_l2norm(tree, per_tensor: bool = False):
+    """Global L2 norm over all float leaves; optionally per-tensor norms too.
+
+    Equivalent of ``amp_C.multi_tensor_l2norm``
+    (``csrc/multi_tensor_l2norm_kernel.cu:16-77, 237``).  Accumulation is
+    fp32 regardless of leaf dtype, like the reference's float accumulators.
+
+    Returns ``global_norm`` or ``(global_norm, per_tensor_norms_list)``.
+    """
+    leaves = _float_leaves(tree)
+    if not leaves:
+        zero = jnp.float32(0)
+        return (zero, []) if per_tensor else zero
+    sq = [jnp.sum(jnp.square(jnp.asarray(x, jnp.float32))) for x in leaves]
+    total = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+    if per_tensor:
+        return total, [jnp.sqrt(s) for s in sq]
+    return total
+
+
+def multi_tensor_maxnorm(tree, per_tensor: bool = False):
+    """Global max-abs (infinity) norm, optionally per-tensor.
+
+    Equivalent of ``MaxNormFunctor``
+    (``csrc/multi_tensor_l2norm_kernel.cu:79-140``), used by NovoGrad's
+    ``norm_type=inf`` mode.
+    """
+    leaves = _float_leaves(tree)
+    if not leaves:
+        zero = jnp.float32(0)
+        return (zero, []) if per_tensor else zero
+    m = [jnp.max(jnp.abs(jnp.asarray(x, jnp.float32))) for x in leaves]
+    total = jnp.max(jnp.stack(m))
+    if per_tensor:
+        return total, m
+    return total
+
+
+# -- flatten / unflatten ------------------------------------------------------
+
+def flatten(tensors):
+    """Concatenate a list of arrays into one flat fp-preserving buffer.
+
+    Equivalent of ``apex_C.flatten`` (``csrc/flatten_unflatten.cpp``), the
+    flat communication buffer used by DDP.  On TPU flat buffers are rarely
+    needed (XLA lays out collectives itself) but the capability is kept for
+    the Reducer/bucket APIs and for host-side checkpoint packing (which has a
+    true native C++ path, see ``apex_tpu/csrc``).
+    """
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat, like):
+    """Split ``flat`` back into arrays shaped like the entries of ``like``."""
+    sizes = [int(jnp.size(t)) for t in like]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+    return [jax.lax.dynamic_slice_in_dim(flat, offsets[i], sizes[i]).reshape(
+        jnp.shape(like[i])).astype(jnp.asarray(like[i]).dtype)
+        for i in range(len(like))]
+
+
+# -- reference-parity shim -----------------------------------------------------
+
+class MultiTensorApply:
+    """API-parity shim for ``multi_tensor_applier(op, noop_buf, lists, *args)``.
+
+    The reference shim forwards to a CUDA kernel with a chunk size
+    (``multi_tensor_apply.py:3-30``).  Here ``op`` is one of the pure
+    functions above; the noop flag is *returned* rather than written into a
+    caller buffer, and chunking is XLA's job.  ``available`` is always True —
+    there is no optional native extension to import.
+    """
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size=2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        return op(*tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

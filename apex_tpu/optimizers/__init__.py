@@ -1,0 +1,16 @@
+"""apex_tpu.optimizers — fused optimizers (SURVEY.md §2.3).
+
+Class API (reference parity): ``FusedAdam``, ``FusedLAMB``, ``FusedNovoGrad``,
+``FusedSGD``, ``FP16_Optimizer``.  Functional API: ``functional`` module and
+optax-style ``fused_adam``/``fused_lamb``/``fused_novograd``/``fused_sgd``.
+"""
+
+from .base import FusedOptimizer                      # noqa: F401
+from .fused_adam import FusedAdam                     # noqa: F401
+from .fused_sgd import FusedSGD                       # noqa: F401
+from .fused_lamb import FusedLAMB                     # noqa: F401
+from .fused_novograd import FusedNovoGrad             # noqa: F401
+from .transforms import (fused_adam, fused_sgd,       # noqa: F401
+                         fused_lamb, fused_novograd)
+from . import functional                              # noqa: F401
+from .fp16_optimizer import FP16_Optimizer            # noqa: F401

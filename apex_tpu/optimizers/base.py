@@ -1,0 +1,210 @@
+"""Stateful optimizer base class — the torch-like imperative API.
+
+The idiomatic JAX path is the functional one (``apex_tpu.optimizers.
+functional`` / the optax-style transforms in ``transforms.py``); this class
+provides the reference's imperative surface (``opt.step()``,
+``opt.zero_grad()``, ``state_dict``) plus the amp handshake that reference
+``apex/amp/_process_optimizer.py`` injects with ``types.MethodType``:
+
+* ``_amp_wire`` — master-weight setup (fp32 masters when the model params are
+  reduced precision; reference ``:28-90``).
+* ``_prepare_amp_backward`` / ``_post_amp_backward`` — stash + unscale
+  machinery incl. gradient accumulation via fused axpby (reference
+  ``:134-241`` and ``post_backward_models_are_masters`` ``:93-131``).
+* ``_arm_skip_step`` — the one-shot skip-step latch armed on overflow
+  (reference ``handle.py:126-151`` patches ``step``; the latch restores
+  itself after one ``step`` call exactly like the patched function).
+
+The actual parameter update is ONE jitted XLA program per optimizer (the
+multi-tensor capability); hyperparameters that may change between steps (lr)
+are passed as traced scalars so no recompilation occurs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..amp import policy as _policy
+from ..amp._amp_state import maybe_print
+
+
+class FusedOptimizer:
+    """Base: subclasses define ``_init_state(params)`` and ``_update`` (a pure
+    function ``(grads, state, params, lr, grad_scale, apply_mask) ->
+    (params, state)``)."""
+
+    def __init__(self, params, defaults: Dict[str, Any]):
+        self.defaults = dict(defaults)
+        self.params = params
+        self.master_params = None          # fp32 masters when amp O2-wired
+        self.state = self._init_state(params)
+        self.loss_scaler = None
+        self.properties = None
+        self._amp_wired = False
+        self._skip_next_step = False
+        self._pending_grads = None         # scaled, model-dtype grads
+        self._stashed_grads = None         # for grad accumulation
+        self._master_grads = None          # unscaled fp32 grads, step() input
+        self._jit_update = jax.jit(self._update_with_config)
+        # param_groups parity: one group holding the whole tree; lr is
+        # mutable between steps without recompilation.
+        self.param_groups = [dict(self.defaults, params=self.params)]
+
+    # -- subclass hooks -----------------------------------------------------
+    def _init_state(self, params):
+        raise NotImplementedError
+
+    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
+        raise NotImplementedError
+
+    def _update_with_config(self, grads, state, params, lr, grad_scale):
+        return self._update(grads, state, params, lr=lr,
+                            grad_scale=grad_scale, apply_mask=None)
+
+    # -- main API -----------------------------------------------------------
+    @property
+    def lr(self):
+        return self.param_groups[0].get("lr", self.defaults.get("lr"))
+
+    @lr.setter
+    def lr(self, value):
+        self.param_groups[0]["lr"] = value
+
+    def value_and_grad(self, loss_fn: Callable, has_aux: bool = False):
+        """Return ``fn(*args) -> (loss, grads)`` differentiating the *scaled*
+        loss w.r.t. the model params (amp-aware).  Convenience for the
+        imperative loop; jit the result for speed."""
+        def scaled(params, *args):
+            out = loss_fn(params, *args)
+            loss = out[0] if has_aux else out
+            if self.loss_scaler is not None:
+                loss = self.loss_scaler.scale_loss(loss)
+            return (loss, out[1]) if has_aux else loss
+
+        vg = jax.value_and_grad(scaled, has_aux=has_aux)
+
+        def fn(*args):
+            return vg(self.params, *args)
+        return fn
+
+    def backward(self, grads):
+        """Deliver gradients of the scaled loss (the ``.backward()`` analog).
+        Multiple calls between steps accumulate (reference grad accumulation
+        contract)."""
+        if self._pending_grads is None:
+            self._pending_grads = grads
+        else:
+            self._pending_grads = jax.tree_util.tree_map(
+                jnp.add, self._pending_grads, grads)
+
+    # -- amp handshake ------------------------------------------------------
+    def _amp_wire(self, properties, loss_scaler, cast_params=None):
+        self.properties = properties
+        self.loss_scaler = loss_scaler
+        self._amp_wired = True
+        if cast_params is not None:
+            model_params = cast_params
+        else:
+            model_params = self.params
+        if properties.master_weights:
+            # fp32 masters are the update target (reference
+            # _process_optimizer.py:28-90: masters swapped into param_groups).
+            self.master_params = _policy.make_master(model_params)
+            self.state = self._init_state(self.master_params)
+        self.params = model_params
+        self.param_groups[0]["params"] = self.params
+
+    def _prepare_amp_backward(self):
+        """Reference ``_prepare_amp_backward`` (:134-150): stash existing
+        grads for accumulation, clear the slate for the new backward."""
+        self._stashed_grads = self._master_grads
+        self._master_grads = None
+        self._pending_grads = None
+
+    def _post_amp_backward(self, loss_scaler):
+        """Unscale scaled model-dtype grads into fp32 master grads
+        (reference ``:153-194``); with stashed grads use the fused axpby
+        accumulation path (``:216-241``)."""
+        if self._pending_grads is None:
+            return
+        if self._stashed_grads is None:
+            self._master_grads, _ = loss_scaler.unscale(self._pending_grads)
+        else:
+            self._master_grads, _ = loss_scaler.unscale_with_stashed(
+                self._pending_grads, self._stashed_grads)
+            self._stashed_grads = None
+        self._pending_grads = None
+
+    def _arm_skip_step(self):
+        self._skip_next_step = True
+
+    # -- step ---------------------------------------------------------------
+    def step(self, grads=None, closure=None):
+        """Apply one update.  ``grads`` defaults to the amp-delivered master
+        grads; without amp pass (unscaled) grads directly."""
+        if closure is not None:
+            closure()
+        if self._skip_next_step:
+            # One-shot skip; clears itself like the reference's
+            # self-restoring patched step (handle.py:126-151).
+            self._skip_next_step = False
+            self._master_grads = None
+            maybe_print("apex_tpu.amp: skipping optimizer step "
+                        "(gradient overflow)")
+            return self.params
+
+        if grads is None:
+            grads = self._master_grads
+            if grads is None and self._pending_grads is not None:
+                # Non-amp imperative use: backward() called without scale_loss.
+                grads = self._pending_grads
+        if grads is None:
+            raise ValueError("step() called with no gradients; pass grads or "
+                             "use backward()/amp.scale_loss first.")
+
+        target = self.master_params if self.master_params is not None else self.params
+        lr = jnp.float32(self.param_groups[0].get("lr", self.defaults.get("lr", 0.0)))
+        new_params, self.state = self._jit_update(
+            grads, self.state, target, lr, jnp.float32(1.0))
+
+        if self.master_params is not None:
+            self.master_params = new_params
+            # master -> model copy (reference _process_optimizer.py:345-356).
+            self.params = _policy.master_to_model(new_params, self.params)
+        else:
+            self.params = new_params
+        self.param_groups[0]["params"] = self.params
+        self._master_grads = None
+        self._pending_grads = None
+        return self.params
+
+    def zero_grad(self, set_grads_to_None: bool = True):
+        """Reference ``zero_grad`` patch (:358-374); grads are explicit here so
+        this just clears pending/stashed state."""
+        self._pending_grads = None
+        self._stashed_grads = None
+        self._master_grads = None
+
+    # -- checkpointing ------------------------------------------------------
+    def state_dict(self):
+        sd = {
+            "state": jax.device_get(self.state),
+            "defaults": dict(self.defaults),
+            "lr": self.param_groups[0].get("lr", self.defaults.get("lr")),
+        }
+        if self.master_params is not None:
+            sd["master_params"] = jax.device_get(self.master_params)
+        return sd
+
+    def load_state_dict(self, sd):
+        self.state = jax.tree_util.tree_map(jnp.asarray, sd["state"])
+        if "lr" in sd and sd["lr"] is not None:
+            self.param_groups[0]["lr"] = sd["lr"]
+        if sd.get("master_params") is not None:
+            self.master_params = jax.tree_util.tree_map(
+                jnp.asarray, sd["master_params"])
+            self.params = _policy.master_to_model(self.master_params, self.params)

@@ -1,0 +1,136 @@
+"""FP16_Optimizer (fused-flavor, legacy) — flat master-weight wrapper.
+
+Reference: ``apex/optimizers/fp16_optimizer.py:4-250``: wraps FusedAdam with
+flat bf16 param groups + flat fp32 masters; ``backward(loss)`` scales;
+``step`` computes the flat grad norm (−1 ⇒ overflow ⇒ skip + dynamic scale
+update) and applies the flat update.  Here "flat" is the pytree itself — XLA
+already fuses — but the grad-norm/overflow/skip state machine is identical.
+
+In JAX ``backward(loss)`` cannot run autodiff by side effect, so
+``backward`` accepts the gradients of the *unscaled* loss times the current
+``loss_scale`` (use ``value_and_grad`` helper), mirroring the legacy flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..amp.loss_scaler import LossScaler
+from ..amp import policy as _policy
+from ..multi_tensor import multi_tensor_l2norm, tree_finite
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=True):
+        self.optimizer = init_optimizer
+        # Masters: fp32 copies of the wrapped optimizer's params.
+        self.fp16_params = init_optimizer.params
+        self.fp32_masters = _policy.make_master(self.fp16_params)
+        init_optimizer.params = self.fp32_masters
+        init_optimizer.state = init_optimizer._init_state(self.fp32_masters)
+
+        if dynamic_loss_scale:
+            args = dynamic_loss_args or {}
+            self.loss_scaler = LossScaler("dynamic", **args)
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self._grads = None
+        self.overflow = False
+        self.first_closure_call_this_step = True
+
+    # -- API ----------------------------------------------------------------
+    def value_and_grad(self, loss_fn, *args, **kwargs):
+        """Compute (loss, grads-of-scaled-loss) w.r.t. the bf16 params."""
+        def scaled(p, *a, **k):
+            return self.loss_scaler.scale_loss(loss_fn(p, *a, **k))
+        loss, grads = jax.value_and_grad(scaled)(self.fp16_params, *args, **kwargs)
+        return loss / self.loss_scaler.state.loss_scale, grads
+
+    def backward(self, grads, update_master_grads=True):
+        if self._grads is None:
+            self._grads = grads
+        else:
+            self._grads = jax.tree_util.tree_map(jnp.add, self._grads, grads)
+        if update_master_grads:
+            self.update_master_grads()
+
+    def update_master_grads(self):
+        if self._grads is None:
+            return
+        self._master_grads, _ = self.loss_scaler.unscale(self._grads)
+        self._grads = None
+
+    def _compute_grad_norm(self, grads):
+        """Flat grad norm; returns −1 on overflow
+        (reference ``fp16_optimizer.py:105-130``)."""
+        norm = multi_tensor_l2norm(grads)
+        finite = tree_finite(grads)
+        return jnp.where(finite, norm, -1.0)
+
+    def clip_master_grads(self, max_norm, norm_type=2):
+        if getattr(self, "_master_grads", None) is None:
+            return 0.0
+        norm = float(jax.device_get(multi_tensor_l2norm(self._master_grads)))
+        if norm > max_norm and norm > 0:
+            coef = max_norm / (norm + 1e-6)
+            self._master_grads = jax.tree_util.tree_map(
+                lambda g: g * coef, self._master_grads)
+        return norm
+
+    def step(self, closure=None):
+        grads = getattr(self, "_master_grads", None)
+        if grads is None:
+            raise ValueError("step() before backward()/update_master_grads()")
+        norm = jax.device_get(self._compute_grad_norm(grads))
+        self.overflow = bool(norm == -1.0)
+        should_skip = self.loss_scaler.update_scale_sync() if self.loss_scaler.dynamic else self.overflow
+        # Dynamic scaler tracks overflow via unscale; static path uses norm.
+        if self.overflow:
+            print("OVERFLOW! Skipping step. Reducing loss scale to {}".format(
+                self.loss_scaler.loss_scale()))
+            self._master_grads = None
+            return
+        self.optimizer.step(grads=grads)
+        self.fp32_masters = self.optimizer.params
+        self.fp16_params = _policy.master_to_model(self.fp32_masters,
+                                                   self.fp16_params)
+        self._master_grads = None
+
+    def zero_grad(self, set_grads_to_None=False):
+        self._grads = None
+        self._master_grads = None
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_dict(self):
+        return {
+            "loss_scaler": self.loss_scaler.state_dict(),
+            "overflow": self.overflow,
+            "optimizer_state_dict": self.optimizer.state_dict(),
+            "fp32_masters": jax.device_get(self.fp32_masters),
+        }
+
+    def load_state_dict(self, sd):
+        self.loss_scaler.load_state_dict(sd["loss_scaler"])
+        self.overflow = sd["overflow"]
+        self.optimizer.load_state_dict(sd["optimizer_state_dict"])
+        self.fp32_masters = jax.tree_util.tree_map(jnp.asarray,
+                                                   sd["fp32_masters"])
+        self.optimizer.params = self.fp32_masters
+        self.fp16_params = _policy.master_to_model(self.fp32_masters,
+                                                   self.fp16_params)
+
+    # Properties (reference parity).
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale()
+
+    @property
+    def state(self):
+        return self.optimizer.state
+
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups

@@ -1,0 +1,293 @@
+"""Pure functional optimizer updates over parameter pytrees.
+
+These are the TPU-native equivalents of the reference's multi-tensor CUDA
+functors (``csrc/multi_tensor_adam.cu``, ``multi_tensor_sgd_kernel.cu``,
+``multi_tensor_lamb.cu``, ``multi_tensor_novograd.cu``): the whole model
+updates in ONE compiled XLA program (the "one or a few kernel launches"
+capability), with fp32 math regardless of storage dtype and an optional
+``apply_mask`` implementing loss-scale step skipping as a device-side select
+instead of host-controlled flow.
+
+Internally each update flattens the pytrees to leaf lists — the direct analog
+of the reference's tensor lists — computes per-leaf updates, and unflattens.
+Each function is shaped like an optax update: ``(grads, state, params) ->
+(new_params, new_state)``, jit/vmap/shard_map-safe, no Python control flow on
+traced values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..multi_tensor import multi_tensor_l2norm
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def _flatten(params, *other_trees):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    others = [jax.tree_util.tree_leaves(t) for t in other_trees]
+    return treedef, leaves, others
+
+
+def _masked(mask, new_leaves, old_tree):
+    """new where mask (scalar bool), old otherwise — the step-skip select."""
+    old_leaves = jax.tree_util.tree_leaves(old_tree)
+    if mask is None:
+        return new_leaves
+    return [jnp.where(mask, n, jnp.asarray(o, n.dtype))
+            for n, o in zip(new_leaves, old_leaves)]
+
+
+def _count_step(step, mask):
+    return step + (1 if mask is None else jnp.where(mask, 1, 0))
+
+
+# -- Adam ---------------------------------------------------------------------
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+def adam_init(params) -> AdamState:
+    z = lambda: jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+    return AdamState(step=jnp.int32(0), exp_avg=z(), exp_avg_sq=z())
+
+
+def adam_update(grads, state: AdamState, params, *,
+                lr, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
+                adam_w_mode=True, bias_correction=True, grad_scale=1.0,
+                apply_mask=None):
+    """Fused Adam/AdamW (reference ``csrc/multi_tensor_adam.cu:23-127``:
+    ADAM_MODE_0 = L2 regularization, ADAM_MODE_1 = decoupled AdamW; host-side
+    bias corrections ``:131-171``).  fp32 math; params may be any float dtype.
+    """
+    step = _count_step(state.step, apply_mask)
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** _f32(step)
+        bc2 = 1.0 - beta2 ** _f32(step)
+    else:
+        bc1 = bc2 = 1.0
+
+    treedef, ps, (gs, ms, vs) = _flatten(params, grads, state.exp_avg,
+                                         state.exp_avg_sq)
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(gs, ps, ms, vs):
+        g = _f32(g) / grad_scale
+        p32 = _f32(p)
+        if not adam_w_mode and weight_decay != 0.0:
+            g = g + weight_decay * p32
+        m_n = beta1 * m + (1.0 - beta1) * g
+        v_n = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+        if adam_w_mode and weight_decay != 0.0:
+            update = update + weight_decay * p32
+        new_p.append((p32 - lr * update).astype(jnp.asarray(p).dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+
+    new_p = _masked(apply_mask, new_p, params)
+    new_m = _masked(apply_mask, new_m, state.exp_avg)
+    new_v = _masked(apply_mask, new_v, state.exp_avg_sq)
+    return (treedef.unflatten(new_p),
+            AdamState(step=step, exp_avg=treedef.unflatten(new_m),
+                      exp_avg_sq=treedef.unflatten(new_v)))
+
+
+# -- SGD ----------------------------------------------------------------------
+
+class SGDState(NamedTuple):
+    momentum_buf: Any
+    initialized: jnp.ndarray
+
+
+def sgd_init(params, momentum=0.0) -> SGDState:
+    buf = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+    return SGDState(momentum_buf=buf, initialized=jnp.asarray(False))
+
+
+def sgd_update(grads, state: SGDState, params, *,
+               lr, momentum=0.0, dampening=0.0, nesterov=False,
+               weight_decay=0.0, wd_after_momentum=False, grad_scale=1.0,
+               apply_mask=None):
+    """Fused SGD (reference ``csrc/multi_tensor_sgd_kernel.cu:141-278``):
+    weight decay, momentum, dampening, nesterov, ``first_run`` momentum
+    initialization, ``wd_after_momentum`` and fused ``1/scale`` grad scaling,
+    all inside the single compiled update.
+    """
+    first_run = jnp.logical_not(state.initialized)
+
+    treedef, ps, (gs, ms) = _flatten(params, grads, state.momentum_buf)
+    new_p, new_m = [], []
+    for g, p, m in zip(gs, ps, ms):
+        g = _f32(g) / grad_scale
+        p32 = _f32(p)
+        if weight_decay != 0.0 and not wd_after_momentum:
+            g = g + weight_decay * p32
+        if momentum != 0.0:
+            m_n = jnp.where(first_run, g, momentum * m + (1.0 - dampening) * g)
+            d = g + momentum * m_n if nesterov else m_n
+        else:
+            m_n = m
+            d = g
+        if weight_decay != 0.0 and wd_after_momentum:
+            d = d + weight_decay * p32
+        new_p.append((p32 - lr * d).astype(jnp.asarray(p).dtype))
+        new_m.append(m_n)
+
+    new_p = _masked(apply_mask, new_p, params)
+    new_m = _masked(apply_mask, new_m, state.momentum_buf)
+    initialized = jnp.logical_or(
+        state.initialized,
+        jnp.asarray(True) if apply_mask is None else apply_mask)
+    return (treedef.unflatten(new_p),
+            SGDState(momentum_buf=treedef.unflatten(new_m),
+                     initialized=initialized))
+
+
+# -- LAMB ---------------------------------------------------------------------
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+def lamb_init(params) -> LambState:
+    z = lambda: jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+    return LambState(step=jnp.int32(0), exp_avg=z(), exp_avg_sq=z())
+
+
+def lamb_update(grads, state: LambState, params, *,
+                lr, beta1=0.9, beta2=0.999, eps=1e-6, weight_decay=0.01,
+                adam_w_mode=True, bias_correction=True, grad_averaging=True,
+                max_grad_norm=1.0, use_nvlamb=False, grad_scale=1.0,
+                apply_mask=None):
+    """Fused LAMB (reference ``csrc/multi_tensor_lamb.cu:29-289``):
+
+    stage 1 — global grad-norm clip (l2norm over ALL grads), m/v update,
+    per-tensor Adam-style update vector; stage 2 — per-tensor trust ratio
+    ``|p| / |update|`` scales the step.  ``use_nvlamb`` applies the trust
+    ratio even when a tensor's param norm is zero.
+    """
+    step = _count_step(state.step, apply_mask)
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** _f32(step)
+        bc2 = 1.0 - beta2 ** _f32(step)
+    else:
+        bc1 = bc2 = 1.0
+
+    treedef, ps, (gs, ms, vs) = _flatten(params, grads, state.exp_avg,
+                                         state.exp_avg_sq)
+    gs = [_f32(g) / grad_scale for g in gs]
+    # Global gradient norm for clipping (reference: one l2norm over all grads).
+    gnorm = multi_tensor_l2norm(gs)
+    if max_grad_norm is not None and max_grad_norm > 0:
+        clip = jnp.where(gnorm > max_grad_norm, gnorm / max_grad_norm, 1.0)
+    else:
+        clip = 1.0
+
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(gs, ps, ms, vs):
+        g = g / clip
+        p32 = _f32(p)
+        m_n = beta1 * m + beta3 * g
+        v_n = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+        if weight_decay != 0.0:
+            update = update + weight_decay * p32
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        if use_nvlamb:
+            ratio = jnp.where(u_norm > 0, p_norm / u_norm, 1.0)
+        else:
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
+        new_p.append((p32 - lr * ratio * update).astype(jnp.asarray(p).dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+
+    new_p = _masked(apply_mask, new_p, params)
+    new_m = _masked(apply_mask, new_m, state.exp_avg)
+    new_v = _masked(apply_mask, new_v, state.exp_avg_sq)
+    return (treedef.unflatten(new_p),
+            LambState(step=step, exp_avg=treedef.unflatten(new_m),
+                      exp_avg_sq=treedef.unflatten(new_v)))
+
+
+# -- NovoGrad -----------------------------------------------------------------
+
+class NovoGradState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any           # per-element first moment
+    exp_avg_sq: Any        # per-TENSOR scalar second moment (norm, not squared)
+
+
+def novograd_init(params) -> NovoGradState:
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+    scalars = jax.tree_util.tree_map(lambda p: jnp.float32(0), params)
+    return NovoGradState(step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=scalars)
+
+
+def novograd_update(grads, state: NovoGradState, params, *,
+                    lr, beta1=0.95, beta2=0.98, eps=1e-8, weight_decay=0.0,
+                    grad_averaging=True, norm_type=2, init_zero=False,
+                    adam_w_mode=True, bias_correction=False, grad_scale=1.0,
+                    apply_mask=None):
+    """Fused NovoGrad (reference ``csrc/multi_tensor_novograd.cu`` +
+    ``apex/optimizers/fused_novograd.py:157-176``): the second moment is ONE
+    SCALAR PER TENSOR — an EMA of the per-tensor grad norm.  First step
+    initializes it to the grad norm itself (or zero with ``init_zero``).
+    """
+    step = _count_step(state.step, apply_mask)
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** _f32(step)
+        bc2 = 1.0 - beta2 ** _f32(step)
+    else:
+        bc1 = bc2 = 1.0
+    first = step == 1
+
+    treedef, ps, (gs, ms, vs) = _flatten(params, grads, state.exp_avg,
+                                         state.exp_avg_sq)
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(gs, ps, ms, vs):
+        g = _f32(g) / grad_scale
+        p32 = _f32(p)
+        if norm_type == 2:
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        else:
+            g_norm = jnp.max(jnp.abs(g))
+        if init_zero:
+            v_n = beta2 * v + (1.0 - beta2) * g_norm
+        else:
+            v_n = jnp.where(first, g_norm, beta2 * v + (1.0 - beta2) * g_norm)
+        denom = v_n / jnp.sqrt(bc2) + eps if bias_correction else v_n + eps
+        scaled_g = g / denom
+        if weight_decay != 0.0 and not adam_w_mode:
+            scaled_g = scaled_g + weight_decay * p32
+        m_n = beta1 * m + beta3 * scaled_g
+        update = m_n / bc1
+        if weight_decay != 0.0 and adam_w_mode:
+            update = update + weight_decay * p32
+        new_p.append((p32 - lr * update).astype(jnp.asarray(p).dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+
+    new_p = _masked(apply_mask, new_p, params)
+    new_m = _masked(apply_mask, new_m, state.exp_avg)
+    new_v = _masked(apply_mask, new_v, state.exp_avg_sq)
+    return (treedef.unflatten(new_p),
+            NovoGradState(step=step, exp_avg=treedef.unflatten(new_m),
+                          exp_avg_sq=treedef.unflatten(new_v)))

@@ -1,0 +1,35 @@
+"""FusedAdam — Adam/AdamW with the whole-model single-program update.
+
+Reference: ``apex/optimizers/fused_adam.py:5-134`` (multi_tensor_adam launch,
+``adam_w_mode`` decoupled weight decay default True, no AMSGrad/sparse).
+"""
+
+from __future__ import annotations
+
+from .base import FusedOptimizer
+from . import functional as F
+
+
+class FusedAdam(FusedOptimizer):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad "
+                               "variant (reference parity).")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        adam_w_mode=adam_w_mode)
+        super().__init__(params, defaults)
+
+    def _init_state(self, params):
+        return F.adam_init(params)
+
+    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
+        d = self.defaults
+        return F.adam_update(
+            grads, state, params, lr=lr,
+            beta1=d["betas"][0], beta2=d["betas"][1], eps=d["eps"],
+            weight_decay=d["weight_decay"], adam_w_mode=d["adam_w_mode"],
+            bias_correction=d["bias_correction"], grad_scale=grad_scale,
+            apply_mask=apply_mask)

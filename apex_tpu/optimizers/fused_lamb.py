@@ -1,0 +1,38 @@
+"""FusedLAMB — layerwise adaptive large-batch optimizer.
+
+Reference: ``apex/optimizers/fused_lamb.py:4-175`` — global grad norm
+computed over all grads, per-tensor trust ratio inside the fused kernel.
+"""
+
+from __future__ import annotations
+
+from .base import FusedOptimizer
+from . import functional as F
+
+
+class FusedLAMB(FusedOptimizer):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, adam_w_mode=True, grad_averaging=True,
+                 set_grad_none=True, max_grad_norm=1.0, use_nvlamb=False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        adam_w_mode=adam_w_mode, grad_averaging=grad_averaging,
+                        max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb)
+        super().__init__(params, defaults)
+
+    def _init_state(self, params):
+        return F.lamb_init(params)
+
+    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
+        d = self.defaults
+        return F.lamb_update(
+            grads, state, params, lr=lr,
+            beta1=d["betas"][0], beta2=d["betas"][1], eps=d["eps"],
+            weight_decay=d["weight_decay"], adam_w_mode=d["adam_w_mode"],
+            bias_correction=d["bias_correction"],
+            grad_averaging=d["grad_averaging"],
+            max_grad_norm=d["max_grad_norm"], use_nvlamb=d["use_nvlamb"],
+            grad_scale=grad_scale, apply_mask=apply_mask)

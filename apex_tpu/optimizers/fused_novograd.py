@@ -1,0 +1,46 @@
+"""FusedNovoGrad — NovoGrad with per-tensor scalar second moments.
+
+Reference: ``apex/optimizers/fused_novograd.py:4-210`` — ``exp_avg_sq`` is one
+float per tensor (a norm EMA, not squared), initialized from the first step's
+grad norm or zero; L2 or inf norm modes.
+"""
+
+from __future__ import annotations
+
+from .base import FusedOptimizer
+from . import functional as F
+
+
+class FusedNovoGrad(FusedOptimizer):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
+                 amsgrad=False, reg_inside_moment=False, grad_averaging=True,
+                 norm_type=2, init_zero=False, set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad "
+                               "variant.")
+        if norm_type not in (2, float("inf"), "inf"):
+            raise RuntimeError("FusedNovoGrad only supports l2/inf norm")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        grad_averaging=grad_averaging,
+                        norm_type=2 if norm_type == 2 else 0,
+                        init_zero=init_zero,
+                        reg_inside_moment=reg_inside_moment)
+        super().__init__(params, defaults)
+
+    def _init_state(self, params):
+        return F.novograd_init(params)
+
+    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
+        d = self.defaults
+        return F.novograd_update(
+            grads, state, params, lr=lr,
+            beta1=d["betas"][0], beta2=d["betas"][1], eps=d["eps"],
+            weight_decay=d["weight_decay"],
+            grad_averaging=d["grad_averaging"],
+            norm_type=2 if d["norm_type"] == 2 else "inf",
+            init_zero=d["init_zero"],
+            adam_w_mode=not d["reg_inside_moment"],
+            bias_correction=d["bias_correction"],
+            grad_scale=grad_scale, apply_mask=apply_mask)

@@ -1,0 +1,96 @@
+"""FusedSGD — momentum/dampening/nesterov SGD, whole-model single program.
+
+Reference: ``apex/optimizers/fused_sgd.py:6-217``.  The reference's marquee
+trick — ``materialize_master_grads=False``, a depth-4 kernel that reads fp16
+model grads and updates fp32 masters + fp16 model copies in one pass with the
+unscale fused in (``:139-214``) — is the *default* here: when amp-wired with
+master weights, ``step`` consumes the scaled bf16 grads directly and fuses
+``1/most_recent_scale`` into the compiled update, so master grads are never
+materialized.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import FusedOptimizer
+from . import functional as F
+from ..amp import policy as _policy
+
+
+class FusedSGD(FusedOptimizer):
+    def __init__(self, params, lr=1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False,
+                 wd_after_momentum=False, materialize_master_grads=True,
+                 set_grad_none=False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero "
+                             "dampening")
+        defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
+                        weight_decay=weight_decay, nesterov=nesterov,
+                        wd_after_momentum=wd_after_momentum)
+        self.materialize_master_grads = materialize_master_grads
+        # Scaler handshake (reference fused_sgd.py most_recent_scale /
+        # scale_set_by_backward): lets the update fuse the unscale.
+        self.most_recent_scale = 1.0
+        self.scale_set_by_backward = False
+        super().__init__(params, defaults)
+
+    def _init_state(self, params):
+        return F.sgd_init(params, self.defaults["momentum"])
+
+    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
+        d = self.defaults
+        return F.sgd_update(
+            grads, state, params, lr=lr, momentum=d["momentum"],
+            dampening=d["dampening"], nesterov=d["nesterov"],
+            weight_decay=d["weight_decay"],
+            wd_after_momentum=d["wd_after_momentum"],
+            grad_scale=grad_scale, apply_mask=apply_mask)
+
+    def _post_amp_backward(self, loss_scaler):
+        if not self.materialize_master_grads and self.master_params is not None:
+            # Fused path: keep the scaled model-dtype grads; record the scale
+            # so step() divides inside the kernel (reference :139-214).
+            if self._pending_grads is None:
+                return
+            if self._stashed_grads is not None:
+                # Accumulation still needs the fp32 sum.
+                self._master_grads, _ = loss_scaler.unscale_with_stashed(
+                    self._pending_grads, self._stashed_grads)
+                self._stashed_grads = None
+                self._pending_grads = None
+                self.most_recent_scale = 1.0
+                self.scale_set_by_backward = True
+                return
+            self._master_grads = self._pending_grads
+            self._pending_grads = None
+            self.most_recent_scale = loss_scaler.loss_scale()
+            self.scale_set_by_backward = True
+            # Overflow check still must happen (device-side).
+            _, _ = loss_scaler.unscale(self._master_grads,
+                                       scale=jnp.float32(self.most_recent_scale))
+            return
+        super()._post_amp_backward(loss_scaler)
+
+    def step(self, grads=None, closure=None):
+        if (grads is None and not self.materialize_master_grads
+                and self.master_params is not None
+                and self._master_grads is not None and not self._skip_next_step):
+            if closure is not None:
+                closure()
+            lr = jnp.float32(self.param_groups[0].get("lr", self.defaults["lr"]))
+            scale = jnp.float32(self.most_recent_scale)
+            new_params, self.state = self._jit_update(
+                self._master_grads, self.state, self.master_params, lr, scale)
+            self.master_params = new_params
+            self.params = _policy.master_to_model(new_params, self.params)
+            self.param_groups[0]["params"] = self.params
+            self._master_grads = None
+            self.most_recent_scale = 1.0
+            self.scale_set_by_backward = False
+            return self.params
+        result = super().step(grads=grads, closure=closure)
+        self.most_recent_scale = 1.0
+        self.scale_set_by_backward = False
+        return result

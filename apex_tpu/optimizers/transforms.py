@@ -1,0 +1,104 @@
+"""optax-compatible GradientTransformation wrappers.
+
+The functional updates in ``functional.py`` return new params directly (the
+fused formulation).  These wrappers adapt them to optax's
+``(updates, state, params) -> (updates, state)`` protocol so apex_tpu
+optimizers drop into existing optax/flax training loops::
+
+    tx = apex_tpu.optimizers.fused_adam(lr=1e-3, weight_decay=0.01)
+    opt_state = tx.init(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+
+``lr`` may be a float or an optax-style schedule ``step -> lr``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from . import functional as F
+
+ScalarOrSchedule = Union[float, Callable]
+
+
+def _lr_at(lr: ScalarOrSchedule, step):
+    return lr(step) if callable(lr) else jnp.float32(lr)
+
+
+def _delta(new_params, params):
+    return jax.tree_util.tree_map(
+        lambda n, p: (jnp.asarray(n, jnp.float32)
+                      - jnp.asarray(p, jnp.float32)).astype(jnp.asarray(p).dtype),
+        new_params, params)
+
+
+def _make(update_fn, init_fn, lr, kwargs):
+    def init(params):
+        return init_fn(params)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("apex_tpu fused transforms require params")
+        new_params, new_state = update_fn(
+            grads, state, params, lr=_lr_at(lr, state.step), **kwargs)
+        return _delta(new_params, params), new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def fused_adam(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
+               adam_w_mode=True, bias_correction=True):
+    return _make(F.adam_update, F.adam_init, lr,
+                 dict(beta1=beta1, beta2=beta2, eps=eps,
+                      weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                      bias_correction=bias_correction))
+
+
+def fused_lamb(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-6, weight_decay=0.01,
+               adam_w_mode=True, bias_correction=True, grad_averaging=True,
+               max_grad_norm=1.0, use_nvlamb=False):
+    return _make(F.lamb_update, F.lamb_init, lr,
+                 dict(beta1=beta1, beta2=beta2, eps=eps,
+                      weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                      bias_correction=bias_correction,
+                      grad_averaging=grad_averaging,
+                      max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb))
+
+
+def fused_novograd(lr=1e-3, beta1=0.95, beta2=0.98, eps=1e-8,
+                   weight_decay=0.0, grad_averaging=True, norm_type=2,
+                   init_zero=False, adam_w_mode=True, bias_correction=False):
+    return _make(F.novograd_update, F.novograd_init, lr,
+                 dict(beta1=beta1, beta2=beta2, eps=eps,
+                      weight_decay=weight_decay, grad_averaging=grad_averaging,
+                      norm_type=norm_type, init_zero=init_zero,
+                      adam_w_mode=adam_w_mode, bias_correction=bias_correction))
+
+
+class _SGDWrapperState(NamedTuple):
+    inner: F.SGDState
+    step: jnp.ndarray
+
+
+def fused_sgd(lr=1e-3, momentum=0.0, dampening=0.0, weight_decay=0.0,
+              nesterov=False, wd_after_momentum=False):
+    def init(params):
+        return _SGDWrapperState(inner=F.sgd_init(params, momentum),
+                                step=jnp.int32(0))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("apex_tpu fused transforms require params")
+        new_params, inner = F.sgd_update(
+            grads, state.inner, params, lr=_lr_at(lr, state.step),
+            momentum=momentum, dampening=dampening, nesterov=nesterov,
+            weight_decay=weight_decay, wd_after_momentum=wd_after_momentum)
+        return _delta(new_params, params), _SGDWrapperState(
+            inner=inner, step=state.step + 1)
+
+    return optax.GradientTransformation(init, update)

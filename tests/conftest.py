@@ -1,0 +1,19 @@
+"""Test configuration: run everything on a simulated 8-device CPU platform.
+
+SURVEY.md §4: the reference can only test distributed behavior on real
+multi-GPU nodes; the TPU build does better by unit-testing DP/SyncBN
+semantics on a virtual CPU mesh.  These env vars must be set before jax
+initializes its backends, hence the top-of-conftest placement.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,199 @@
+"""amp core tests: Properties state machine, policy casting, loss scaler,
+checkpoint round-trip.  Mirrors reference ``tests/L0/run_amp`` semantics
+(test_basic_casts, test_checkpointing state parts).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu
+from apex_tpu import amp
+from apex_tpu.amp import LossScaler
+from apex_tpu.amp.properties import AmpOptionError, opt_levels
+
+
+# -- Properties / opt levels --------------------------------------------------
+
+def test_opt_level_presets():
+    o2 = opt_levels["O2"]()
+    assert o2.cast_model_type == jnp.bfloat16
+    assert o2.master_weights is True
+    assert o2.keep_batchnorm_fp32 is True
+    assert not o2.patch_functions
+    o1 = opt_levels["O1"]()
+    assert o1.patch_functions
+    assert o1.cast_model_type is None
+    o0 = opt_levels["O0"]()
+    assert o0.cast_model_type == jnp.float32
+
+
+def test_properties_rejects_unknown_option():
+    p = opt_levels["O2"]()
+    with pytest.raises(AmpOptionError):
+        p.bogus_option = 3
+
+
+def test_properties_rejects_inconsistent_combos():
+    p = opt_levels["O1"]()
+    with pytest.raises(AmpOptionError):
+        p.cast_model_type = jnp.bfloat16  # O1 + whole-model cast
+    p2 = opt_levels["O2"]()
+    with pytest.raises(AmpOptionError):
+        p2.patch_functions = True  # O2 + patching
+    with pytest.raises(AmpOptionError):
+        p2.keep_batchnorm_fp32 = "maybe"
+    with pytest.raises(AmpOptionError):
+        p2.loss_scale = -1.0
+
+
+def test_initialize_rejects_bad_opt_level():
+    with pytest.raises(AmpOptionError):
+        amp.initialize(opt_level="O4")
+    with pytest.raises(AmpOptionError):
+        amp.initialize(opt_level="02")  # zero-two, the classic typo
+
+
+# -- policy casting -----------------------------------------------------------
+
+def _params():
+    return {
+        "conv1": {"kernel": jnp.ones((3, 3, 4, 8), jnp.float32)},
+        "bn1": {"scale": jnp.ones((8,), jnp.float32),
+                "bias": jnp.zeros((8,), jnp.float32)},
+        "dense": {"kernel": jnp.ones((8, 2), jnp.float32),
+                  "bias": jnp.zeros((2,), jnp.float32)},
+    }
+
+
+def test_convert_params_keep_bn_fp32():
+    cast = amp.convert_params(_params(), jnp.bfloat16, keep_norm_fp32=True)
+    assert cast["conv1"]["kernel"].dtype == jnp.bfloat16
+    assert cast["dense"]["kernel"].dtype == jnp.bfloat16
+    assert cast["bn1"]["scale"].dtype == jnp.float32
+    assert cast["bn1"]["bias"].dtype == jnp.float32
+
+
+def test_convert_params_no_keep():
+    cast = amp.convert_params(_params(), jnp.bfloat16, keep_norm_fp32=False)
+    assert cast["bn1"]["scale"].dtype == jnp.bfloat16
+
+
+def test_to_type_skips_integers():
+    tree = {"x": jnp.ones((2,), jnp.float32), "idx": jnp.arange(3)}
+    out = amp.to_type(jnp.bfloat16, tree)
+    assert out["x"].dtype == jnp.bfloat16
+    assert out["idx"].dtype == jnp.int32
+
+
+def test_wrap_forward_casts_inputs_and_outputs():
+    seen = {}
+
+    def apply_fn(x):
+        seen["dtype"] = x.dtype
+        return x * 2
+
+    f = amp.wrap_forward(apply_fn, cast_input_type=jnp.bfloat16)
+    out = f(jnp.ones((4,), jnp.float32))
+    assert seen["dtype"] == jnp.bfloat16
+    assert out.dtype == jnp.float32
+
+
+# -- loss scaler --------------------------------------------------------------
+
+def test_static_scaler_noop():
+    s = LossScaler(1.0)
+    assert s.scale_loss(jnp.float32(3.0)) == 3.0
+    grads, _ = s.unscale([jnp.ones((4,))])
+    np.testing.assert_allclose(np.asarray(grads[0]), 1.0)
+
+
+def test_static_scaler_scales():
+    s = LossScaler(128.0)
+    assert float(s.scale_loss(jnp.float32(2.0))) == 256.0
+    grads, _ = s.unscale([jnp.full((4,), 128.0)])
+    np.testing.assert_allclose(np.asarray(grads[0]), 1.0)
+
+
+def test_dynamic_scaler_backoff_and_growth():
+    s = LossScaler("dynamic", init_scale=2.**4, scale_window=3)
+    assert s.loss_scale() == 16.0
+    # Overflow -> halve.
+    _, _ = s.unscale([jnp.asarray([np.inf], np.float32)])
+    skip = s.update_scale_sync()
+    assert skip
+    assert s.loss_scale() == 8.0
+    # 3 clean steps -> double.
+    for _ in range(3):
+        _, _ = s.unscale([jnp.ones((2,))])
+        assert not s.update_scale_sync()
+    assert s.loss_scale() == 16.0
+
+
+def test_dynamic_scaler_respects_bounds():
+    s = LossScaler("dynamic", init_scale=4.0, scale_window=1,
+                   min_loss_scale=2.0, max_loss_scale=8.0)
+    _, _ = s.unscale([jnp.asarray([np.nan], np.float32)])
+    s.update_scale_sync()
+    assert s.loss_scale() == 2.0
+    _, _ = s.unscale([jnp.asarray([np.nan], np.float32)])
+    s.update_scale_sync()
+    assert s.loss_scale() == 2.0  # clamped at min
+    for _ in range(3):
+        _, _ = s.unscale([jnp.ones((2,))])
+        s.update_scale_sync()
+    assert s.loss_scale() == 8.0  # clamped at max
+
+
+def test_scaler_functional_jit():
+    s = LossScaler("dynamic", init_scale=8.0, scale_window=100)
+
+    @jax.jit
+    def step(state, grads):
+        out, state = s.unscale(grads, state)
+        state = s.update_scale(state)
+        return out, state
+
+    state = s.init()
+    out, state = step(state, [jnp.full((4,), 8.0)])
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+    assert float(state.loss_scale) == 8.0
+    out, state = step(state, [jnp.asarray([np.inf, 1.0, 1.0, 1.0], np.float32)])
+    assert float(state.loss_scale) == 4.0
+
+
+def test_unscale_with_stashed():
+    s = LossScaler(4.0)
+    out, _ = s.unscale_with_stashed([jnp.full((3,), 8.0)],
+                                    [jnp.full((3,), 1.0)])
+    np.testing.assert_allclose(np.asarray(out[0]), 3.0)  # 8/4 + 1
+
+
+# -- amp state_dict round trip ------------------------------------------------
+
+def test_amp_state_dict_roundtrip():
+    amp.initialize(opt_level="O2", loss_scale="dynamic", num_losses=2,
+                   verbosity=0)
+    sd = amp.state_dict()
+    assert set(sd) == {"loss_scaler0", "loss_scaler1"}
+    assert sd["loss_scaler0"]["loss_scale"] == 2.**16
+    # Simulate an overflow on scaler 0, then restore.
+    from apex_tpu.amp._amp_state import _amp_state
+    _amp_state.loss_scalers[0].unscale([jnp.asarray([np.inf], np.float32)])
+    _amp_state.loss_scalers[0].update_scale_sync()
+    assert amp.state_dict()["loss_scaler0"]["loss_scale"] == 2.**15
+    amp.load_state_dict(sd)
+    assert amp.state_dict()["loss_scaler0"]["loss_scale"] == 2.**16
+
+
+def test_initialize_casts_model_o2():
+    params, = amp.initialize([_params()], opt_level="O2", verbosity=0),
+    params = params[0]
+    assert params["conv1"]["kernel"].dtype == jnp.bfloat16
+    assert params["bn1"]["scale"].dtype == jnp.float32
+
+
+def test_initialize_o0_stays_fp32():
+    params = amp.initialize(_params(), opt_level="O0", verbosity=0)
+    assert params["conv1"]["kernel"].dtype == jnp.float32

@@ -1,0 +1,109 @@
+"""Multi-tensor engine tests.
+
+Mirrors reference ``tests/L0/run_amp/test_multi_tensor_scale.py`` /
+``_axpby`` / ``_l2norm``: fuzz sizes around chunk boundaries, inject inf/nan
+at the first/last element of each tensor, assert the overflow flag, and check
+mixed in/out dtypes (bf16 <-> fp32 instead of fp16 <-> fp32).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import multi_tensor as mta
+
+CHUNK = 2048 * 32
+# Reference fuzz pattern: sizes straddling chunk boundaries (test_fuzz :88-126).
+SIZES = [7, 256, CHUNK - 1, CHUNK, CHUNK + 1]
+
+
+def _make_trees(sizes, dtype, val=4.0):
+    return [jnp.full((s,), val, dtype=dtype) for s in sizes]
+
+
+@pytest.mark.parametrize("in_dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("out_dtype", [jnp.float32, jnp.bfloat16])
+def test_scale_values_and_dtypes(in_dtype, out_dtype):
+    trees = _make_trees([33, 1025], in_dtype)
+    out, overflow = mta.multi_tensor_scale(trees, 0.5, out_dtype=out_dtype)
+    assert not bool(overflow)
+    for o in out:
+        assert o.dtype == jnp.dtype(out_dtype)
+        np.testing.assert_allclose(np.asarray(o, np.float32), 2.0)
+
+
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("bad", [float("inf"), float("nan")])
+@pytest.mark.parametrize("pos", ["first", "last"])
+def test_scale_overflow_injection(size, bad, pos):
+    x = np.full((size,), 1.0, np.float32)
+    x[0 if pos == "first" else -1] = bad
+    trees = [jnp.ones((17,), jnp.float32), jnp.asarray(x)]
+    _, overflow = mta.multi_tensor_scale(trees, 1.0)
+    assert bool(overflow)
+
+
+def test_axpby():
+    x = [jnp.full((100,), 2.0), jnp.full((CHUNK + 1,), 4.0)]
+    y = [jnp.full((100,), 1.0), jnp.full((CHUNK + 1,), 1.0)]
+    out, overflow = mta.multi_tensor_axpby(x, y, 0.5, 2.0)
+    assert not bool(overflow)
+    np.testing.assert_allclose(np.asarray(out[0]), 3.0)
+    np.testing.assert_allclose(np.asarray(out[1]), 4.0)
+
+
+def test_axpby_overflow():
+    x = [jnp.asarray([1.0, np.nan, 1.0], jnp.float32)]
+    y = [jnp.ones((3,), jnp.float32)]
+    _, overflow = mta.multi_tensor_axpby(x, y, 1.0, 1.0)
+    assert bool(overflow)
+
+
+def test_l2norm_global_and_per_tensor():
+    trees = [jnp.full((4,), 3.0), jnp.full((9,), 2.0)]
+    # sqrt(4*9 + 9*4) = sqrt(72)
+    g = mta.multi_tensor_l2norm(trees)
+    np.testing.assert_allclose(float(g), np.sqrt(72.0), rtol=1e-6)
+    g2, per = mta.multi_tensor_l2norm(trees, per_tensor=True)
+    np.testing.assert_allclose(float(per[0]), 6.0, rtol=1e-6)
+    np.testing.assert_allclose(float(per[1]), 6.0, rtol=1e-6)
+
+
+def test_l2norm_works_on_pytrees():
+    tree = {"a": jnp.ones((3, 3)), "b": {"c": jnp.ones((9,))}}
+    np.testing.assert_allclose(float(mta.multi_tensor_l2norm(tree)),
+                               np.sqrt(18.0), rtol=1e-6)
+
+
+def test_maxnorm():
+    trees = [jnp.asarray([1.0, -7.0]), jnp.asarray([3.0])]
+    assert float(mta.multi_tensor_maxnorm(trees)) == 7.0
+
+
+def test_flatten_unflatten_roundtrip():
+    tensors = [jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+               jnp.arange(4, dtype=jnp.float32)]
+    flat = mta.flatten(tensors)
+    assert flat.shape == (10,)
+    back = mta.unflatten(flat, tensors)
+    for a, b in zip(back, tensors):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_applier_shim():
+    out, flag = mta.multi_tensor_applier(
+        mta.multi_tensor_scale, jnp.zeros((1,), jnp.int32),
+        [[jnp.ones((8,))]], 2.0)
+    np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+
+
+def test_jit_composability():
+    @jax.jit
+    def f(tree):
+        out, overflow = mta.multi_tensor_scale(tree, 2.0)
+        return mta.multi_tensor_l2norm(out), overflow
+
+    norm, overflow = f([jnp.ones((16,))])
+    np.testing.assert_allclose(float(norm), 8.0, rtol=1e-6)
+    assert not bool(overflow)
