@@ -1,0 +1,4 @@
+"""apex_tpu.normalization — fused normalization layers (SURVEY.md §2.5)."""
+
+from .fused_layer_norm import (FusedLayerNorm, fused_layer_norm,  # noqa: F401
+                               fused_layer_norm_affine)

@@ -1,0 +1,266 @@
+"""FusedLayerNorm — Pallas TPU kernel with custom VJP.
+
+TPU-native re-design of the reference ``apex/normalization/fused_layer_norm.py``
++ ``csrc/layer_norm_cuda_kernel.cu``:
+
+* semantics match ``nn.LayerNorm`` (normalized_shape / eps /
+  elementwise_affine), reference ``fused_layer_norm.py:70-165``;
+* the forward returns (output, mean, invvar) and saves mean/invvar for the
+  backward — the memory-saving trick of ``cuApplyLayerNorm``
+  (``layer_norm_cuda_kernel.cu:280-402``);
+* input shape is split into (n1, n2) = (rows, normalized elements) exactly
+  like ``compute_n1_n2`` (``layer_norm_cuda.cpp:7-27``);
+* reduced-precision inputs accumulate in fp32 (reference promote semantics).
+
+The Pallas kernel processes a block of rows per grid step: mean/var via a
+single pass (mean of x and of x**2 — the Welford recombination of the CUDA
+kernel is only needed because CUDA reduces across *threads*; a VPU row
+reduction is single-pass), normalize, apply affine.  The backward kernel
+computes grad_input in one pass from saved mean/invvar; grad_weight/grad_bias
+are column reductions XLA already does optimally, so they stay as jnp ops
+fused into the same program.
+
+Off-TPU (CPU tests) the same math runs as pure jnp — this doubles as the
+reference oracle, mirroring the reference's python-fallback-vs-kernel testing
+strategy.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import numbers
+import os
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only import; absent on CPU-only installs.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("APEX_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu" and pltpu is not None
+
+
+def _normalize_shape(normalized_shape) -> Tuple[int, ...]:
+    if isinstance(normalized_shape, numbers.Integral):
+        return (int(normalized_shape),)
+    return tuple(int(s) for s in normalized_shape)
+
+
+def _compute_n1_n2(shape, normalized_shape):
+    """Split input shape into outer rows n1 and normalized cols n2
+    (reference ``layer_norm_cuda.cpp:7-27``)."""
+    ns = _normalize_shape(normalized_shape)
+    if tuple(shape[len(shape) - len(ns):]) != ns:
+        raise ValueError(
+            "Expected the trailing dims of input shape {} to equal "
+            "normalized_shape {}".format(shape, ns))
+    n2 = math.prod(ns) if ns else 1
+    n1 = math.prod(shape) // n2
+    return n1, n2
+
+
+# -- reference math (jnp; CPU fallback and autodiff oracle) -------------------
+
+def _fwd_ref(x2d, weight, bias, eps):
+    xf = x2d.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=1, keepdims=True)
+    var = jnp.mean(jnp.square(xf), axis=1, keepdims=True) - jnp.square(mean)
+    invvar = jax.lax.rsqrt(var + eps)
+    xhat = (xf - mean) * invvar
+    out = xhat
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x2d.dtype), mean[:, 0], invvar[:, 0]
+
+
+def _bwd_input_ref(g2d, x2d, mean, invvar, weight):
+    """grad wrt input (reference ``cuComputeGradInput``,
+    ``layer_norm_cuda_kernel.cu:523-639``)."""
+    n2 = x2d.shape[1]
+    gf = g2d.astype(jnp.float32)
+    if weight is not None:
+        gf = gf * weight.astype(jnp.float32)
+    xf = x2d.astype(jnp.float32)
+    mean = mean[:, None]
+    invvar = invvar[:, None]
+    xhat = (xf - mean) * invvar
+    sum_g = jnp.sum(gf, axis=1, keepdims=True)
+    sum_gx = jnp.sum(gf * xhat, axis=1, keepdims=True)
+    dx = (gf - sum_g / n2 - xhat * sum_gx / n2) * invvar
+    return dx.astype(x2d.dtype)
+
+
+# -- pallas kernels -----------------------------------------------------------
+
+_ROW_BLOCK = 256
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, out_ref, mean_ref, invvar_ref, *,
+                eps, affine, has_bias):
+    xf = x_ref[:].astype(jnp.float32)
+    n2 = xf.shape[1]
+    mean = jnp.sum(xf, axis=1, keepdims=True) / n2
+    var = jnp.sum(xf * xf, axis=1, keepdims=True) / n2 - mean * mean
+    invvar = jax.lax.rsqrt(var + eps)
+    xhat = (xf - mean) * invvar
+    if affine:
+        xhat = xhat * w_ref[:].astype(jnp.float32)
+        if has_bias:
+            xhat = xhat + b_ref[:].astype(jnp.float32)
+    out_ref[:] = xhat.astype(out_ref.dtype)
+    mean_ref[:] = mean
+    invvar_ref[:] = invvar
+
+
+def _bwd_kernel(g_ref, x_ref, mean_ref, invvar_ref, w_ref, dx_ref, *, affine):
+    gf = g_ref[:].astype(jnp.float32)
+    if affine:
+        gf = gf * w_ref[:].astype(jnp.float32)
+    xf = x_ref[:].astype(jnp.float32)
+    n2 = xf.shape[1]
+    mean = mean_ref[:]
+    invvar = invvar_ref[:]
+    xhat = (xf - mean) * invvar
+    sum_g = jnp.sum(gf, axis=1, keepdims=True) / n2
+    sum_gx = jnp.sum(gf * xhat, axis=1, keepdims=True) / n2
+    dx_ref[:] = ((gf - sum_g - xhat * sum_gx) * invvar).astype(dx_ref.dtype)
+
+
+def _pallas_fwd(x2d, weight, bias, eps):
+    n1, n2 = x2d.shape
+    rows = min(_ROW_BLOCK, n1)
+    grid = (pl.cdiv(n1, rows),)
+    affine = weight is not None
+    has_bias = bias is not None
+    w = weight if affine else jnp.zeros((n2,), x2d.dtype)
+    b = bias if has_bias else jnp.zeros((n2,), x2d.dtype)
+    kernel = functools.partial(_fwd_kernel, eps=eps, affine=affine,
+                               has_bias=has_bias)
+    out, mean, invvar = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, n2), lambda i: (i, 0)),
+            pl.BlockSpec((n2,), lambda i: (0,)),
+            pl.BlockSpec((n2,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, n2), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n1, n2), x2d.dtype),
+            jax.ShapeDtypeStruct((n1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n1, 1), jnp.float32),
+        ],
+    )(x2d, w, b)
+    return out, mean[:, 0], invvar[:, 0]
+
+
+def _pallas_bwd_input(g2d, x2d, mean, invvar, weight):
+    n1, n2 = x2d.shape
+    rows = min(_ROW_BLOCK, n1)
+    grid = (pl.cdiv(n1, rows),)
+    affine = weight is not None
+    w = weight if affine else jnp.zeros((n2,), x2d.dtype)
+    kernel = functools.partial(_bwd_kernel, affine=affine)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, n2), lambda i: (i, 0)),
+            pl.BlockSpec((rows, n2), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((n2,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((rows, n2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n1, n2), x2d.dtype),
+    )(g2d, x2d, mean[:, None], invvar[:, None], w)
+
+
+# -- public functional API with custom VJP ------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _layer_norm(x2d, weight, bias, eps, use_pallas):
+    out, _, _ = (_pallas_fwd if use_pallas else _fwd_ref)(x2d, weight, bias, eps)
+    return out
+
+
+def _layer_norm_fwd(x2d, weight, bias, eps, use_pallas):
+    out, mean, invvar = (_pallas_fwd if use_pallas else _fwd_ref)(
+        x2d, weight, bias, eps)
+    return out, (x2d, weight, bias, mean, invvar)
+
+
+def _layer_norm_bwd(eps, use_pallas, res, g):
+    x2d, weight, bias, mean, invvar = res
+    dx = (_pallas_bwd_input if use_pallas else _bwd_input_ref)(
+        g, x2d, mean, invvar, weight)
+    if weight is not None:
+        xhat = ((x2d.astype(jnp.float32) - mean[:, None]) * invvar[:, None])
+        dw = jnp.sum(g.astype(jnp.float32) * xhat, axis=0).astype(weight.dtype)
+    else:
+        dw = None
+    if bias is not None:
+        db = jnp.sum(g.astype(jnp.float32), axis=0).astype(bias.dtype)
+    else:
+        db = None
+    return dx, dw, db
+
+
+_layer_norm.defvjp(_layer_norm_fwd, _layer_norm_bwd)
+
+
+def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
+    """Functional fused layer norm (reference ``fused_layer_norm.py:64-68``
+    ``fused_layer_norm``/``fused_layer_norm_affine``)."""
+    n1, n2 = _compute_n1_n2(x.shape, normalized_shape)
+    x2d = x.reshape(n1, n2)
+    w = weight.reshape(n2) if weight is not None else None
+    b = bias.reshape(n2) if bias is not None else None
+    out = _layer_norm(x2d, w, b, float(eps), _use_pallas())
+    return out.reshape(x.shape)
+
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5):
+    return fused_layer_norm(x, normalized_shape, weight, bias, eps)
+
+
+# -- flax module --------------------------------------------------------------
+
+import flax.linen as nn  # noqa: E402
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in ``nn.LayerNorm``-semantics module backed by the Pallas kernel
+    (reference ``FusedLayerNorm`` module, ``fused_layer_norm.py:70-165``).
+
+    Parameters are created fp32 (keep-norm-fp32 friendly); inputs of any
+    float dtype are handled with fp32 accumulation.
+    """
+    normalized_shape: Union[int, Sequence[int]] = None
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        ns = _normalize_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("scale", nn.initializers.ones, ns, jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, ns, jnp.float32)
+        else:
+            weight = bias = None
+        return fused_layer_norm(x, ns, weight, bias, self.eps)

@@ -1,0 +1,109 @@
+"""FusedLayerNorm parity tests.
+
+Mirrors reference ``tests/L0/run_fused_layer_norm``: compare against the
+framework's own LayerNorm (flax) forward and backward, affine and
+non-affine, multiple shapes and dtypes, plus torch CPU as an independent
+oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import flax.linen as nn
+
+from apex_tpu.normalization import (FusedLayerNorm, fused_layer_norm,
+                                    fused_layer_norm_affine)
+
+SHAPES = [((4, 16), (16,)), ((2, 3, 32), (32,)), ((8, 6, 4), (6, 4)),
+          ((5, 128), (128,))]
+
+
+@pytest.mark.parametrize("shape,ns", SHAPES)
+def test_forward_matches_torch(shape, ns):
+    rng = np.random.RandomState(0)
+    x = rng.randn(*shape).astype(np.float32)
+    w = rng.randn(*ns).astype(np.float32)
+    b = rng.randn(*ns).astype(np.float32)
+    out = fused_layer_norm_affine(jnp.asarray(x), jnp.asarray(w),
+                                  jnp.asarray(b), ns)
+    expected = torch.nn.functional.layer_norm(
+        torch.tensor(x), ns, torch.tensor(w), torch.tensor(b))
+    np.testing.assert_allclose(np.asarray(out), expected.numpy(),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_forward_no_affine():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 33).astype(np.float32)
+    out = fused_layer_norm(jnp.asarray(x), 33)
+    expected = torch.nn.functional.layer_norm(torch.tensor(x), (33,))
+    np.testing.assert_allclose(np.asarray(out), expected.numpy(),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape,ns", SHAPES)
+def test_backward_matches_torch(shape, ns):
+    rng = np.random.RandomState(2)
+    x = rng.randn(*shape).astype(np.float32)
+    w = rng.randn(*ns).astype(np.float32)
+    b = rng.randn(*ns).astype(np.float32)
+
+    def loss(x_, w_, b_):
+        return jnp.sum(jnp.sin(fused_layer_norm_affine(x_, w_, b_, ns)))
+
+    dx, dw, db = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    tb = torch.tensor(b, requires_grad=True)
+    torch.sum(torch.sin(torch.nn.functional.layer_norm(tx, ns, tw, tb))).backward()
+    np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), atol=1e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw), tw.grad.numpy(), atol=1e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(db), tb.grad.numpy(), atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_bf16_input_fp32_accumulation():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 64).astype(np.float32)
+    out_bf16 = fused_layer_norm(jnp.asarray(x, jnp.bfloat16), 64)
+    out_f32 = fused_layer_norm(jnp.asarray(x), 64)
+    assert out_bf16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_bf16, np.float32),
+                               np.asarray(out_f32), atol=3e-2, rtol=3e-2)
+
+
+def test_flax_module_matches_flax_layernorm():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(3, 48).astype(np.float32))
+    m = FusedLayerNorm(normalized_shape=48)
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    ref = nn.LayerNorm(epsilon=1e-5).apply(
+        {"params": {"scale": params["params"]["scale"],
+                    "bias": params["params"]["bias"]}}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_shape_mismatch_raises():
+    with pytest.raises(ValueError):
+        fused_layer_norm(jnp.ones((4, 8)), 16)
+
+
+def test_jit_and_grad_composability():
+    @jax.jit
+    def f(x, w, b):
+        return jnp.sum(fused_layer_norm_affine(x, w, b, (32,)) ** 2)
+
+    g = jax.jit(jax.grad(f))
+    x = jnp.ones((4, 32)) + jnp.arange(32, dtype=jnp.float32)
+    out = g(x, jnp.ones((32,)), jnp.zeros((32,)))
+    assert out.shape == (4, 32)
+    assert np.isfinite(np.asarray(out)).all()
