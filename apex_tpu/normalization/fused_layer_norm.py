@@ -46,6 +46,11 @@ except ImportError:  # pragma: no cover
 def _use_pallas() -> bool:
     if os.environ.get("APEX_TPU_DISABLE_PALLAS"):
         return False
+    # Respect an explicit non-TPU default device (e.g. the CPU test mesh):
+    # Mosaic kernels only lower on the TPU backend.
+    default_dev = jax.config.jax_default_device
+    if default_dev is not None and getattr(default_dev, "platform", None) != "tpu":
+        return False
     return jax.default_backend() == "tpu" and pltpu is not None
 
 

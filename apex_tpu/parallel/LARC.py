@@ -1,0 +1,105 @@
+"""LARC — layerwise adaptive rate control.
+
+Reference: ``apex/parallel/LARC.py:5-97``.  Per-param adaptive LR
+``trust_coefficient * ||p|| / (||g|| + wd * ||p|| + eps)``, clip mode
+(``min(adaptive/lr, 1)``) or scale mode; implemented by rewriting gradients
+before delegating to the wrapped optimizer, absorbing weight decay into the
+rewritten grad (the reference temporarily zeroes group weight decay the same
+way).
+
+Two forms: ``LARC`` wraps an ``apex_tpu.optimizers`` class instance;
+``larc_transform`` is the optax-style gradient transformation.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def larc_gradients(grads, params, *, lr, trust_coefficient=0.02, clip=True,
+                   eps=1e-8, weight_decay=0.0):
+    """Rewrite grads with the LARC adaptive rate (pure, jit-safe)."""
+    def one(g, p):
+        gf = jnp.asarray(g, jnp.float32)
+        pf = jnp.asarray(p, jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+        adaptive_lr = (trust_coefficient * p_norm
+                       / (g_norm + p_norm * weight_decay + eps))
+        ok = (p_norm != 0) & (g_norm != 0)
+        adaptive_lr = jnp.where(ok, adaptive_lr, 1.0)
+        if clip:
+            adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+        new_g = (gf + weight_decay * pf) * adaptive_lr
+        return new_g.astype(jnp.asarray(g).dtype)
+
+    return jax.tree_util.tree_map(one, grads, params)
+
+
+class LARC:
+    """Optimizer wrapper (reference class).  ``optim`` is an
+    ``apex_tpu.optimizers.FusedOptimizer``; its weight decay is absorbed into
+    the LARC grad rewrite exactly like the reference absorbs/restores group
+    weight decay."""
+
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True, eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.eps = eps
+        self.clip = clip
+
+    def __getattr__(self, name):
+        return getattr(self.optim, name)
+
+    @property
+    def param_groups(self):
+        return self.optim.param_groups
+
+    def step(self, grads=None, closure=None):
+        if grads is None:
+            grads = self.optim._master_grads or self.optim._pending_grads
+        wd = self.optim.defaults.get("weight_decay", 0.0)
+        lr = self.optim.param_groups[0].get("lr",
+                                            self.optim.defaults.get("lr"))
+        target = (self.optim.master_params
+                  if self.optim.master_params is not None
+                  else self.optim.params)
+        new_grads = larc_gradients(grads, target, lr=lr,
+                                   trust_coefficient=self.trust_coefficient,
+                                   clip=self.clip, eps=self.eps,
+                                   weight_decay=wd)
+        # Absorb wd: temporarily zero it in the inner update (reference :42-97).
+        saved = self.optim.defaults.get("weight_decay", 0.0)
+        self.optim.defaults["weight_decay"] = 0.0
+        try:
+            return self.optim.step(grads=new_grads, closure=closure)
+        finally:
+            self.optim.defaults["weight_decay"] = saved
+
+    def state_dict(self):
+        return self.optim.state_dict()
+
+    def load_state_dict(self, sd):
+        self.optim.load_state_dict(sd)
+
+
+def larc_transform(lr, trust_coefficient=0.02, clip=True, eps=1e-8,
+                   weight_decay=0.0) -> optax.GradientTransformation:
+    """optax gradient transformation: chain before any base optimizer."""
+    def init(params):
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc_transform requires params")
+        lr_v = lr(0) if callable(lr) else lr
+        return larc_gradients(grads, params, lr=lr_v,
+                              trust_coefficient=trust_coefficient,
+                              clip=clip, eps=eps,
+                              weight_decay=weight_decay), state
+
+    return optax.GradientTransformation(init, update)

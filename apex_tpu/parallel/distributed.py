@@ -1,0 +1,232 @@
+"""Distributed data parallelism — the DDP contract over XLA collectives.
+
+TPU-native re-design of reference ``apex/parallel/distributed.py:129-639``.
+
+The reference DDP is a *scheduling layer* over NCCL: backward hooks, dtype
+buckets built in backward-arrival order, flatten/allreduce/unflatten on side
+CUDA streams.  Under SPMD compilation all of that machinery dissolves — XLA
+schedules and overlaps collectives itself (SURVEY.md §5) — but the DDP
+*contract* is preserved:
+
+* params synced across replicas at wrap time          (``broadcast_params``)
+* grads averaged across replicas by step time          (``reduce_gradients``)
+* ``delay_allreduce`` / ``no_sync``-style accumulation (``no_sync``)
+* ``gradient_average`` + ``gradient_predivide_factor`` (pre/post divide to
+  protect reduced-precision dynamic range, reference ``:445-454``)
+* ``allreduce_always_fp32``                            (reference ``:442-457``)
+* sub-groups / round-robin communicators → ``axis_index_groups`` on the HLO
+  all-reduce (reference process groups ``:604-624``)
+
+Usage inside ``shard_map``/``pmap`` over a mesh axis::
+
+    ddp = DistributedDataParallel(axis_name="data",
+                                  allreduce_always_fp32=True)
+    grads = ddp.reduce_gradients(grads)        # inside the mapped fn
+
+or functionally: ``reduce_gradients(grads, axis_name="data", ...)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _is_float(x):
+    return hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype,
+                                                  jnp.floating)
+
+
+def group_psum(x, axis_name: str, axis_index_groups=None):
+    """``psum`` over ``axis_name``, optionally restricted to rank sub-groups.
+
+    Sub-grouped all-reduce is the HLO ``replica_groups`` feature (reference
+    process groups, SURVEY.md §5).  ``shard_map`` does not accept
+    ``axis_index_groups`` on ``psum``, so groups lower to
+    ``all_gather`` + a static membership mask contraction — a single
+    collective plus an on-chip reduction, numerically identical to the
+    grouped all-reduce.
+    """
+    if axis_index_groups is None:
+        return lax.psum(x, axis_name)
+    world = lax.axis_size(axis_name)
+    import numpy as _np
+    member = _np.zeros((world, world), _np.float32)
+    for g in axis_index_groups:
+        for i in g:
+            for j in g:
+                member[i, j] = 1.0
+    idx = lax.axis_index(axis_name)
+    gathered = lax.all_gather(x, axis_name)              # [world, ...]
+    w = jnp.take(jnp.asarray(member), idx, axis=0)       # [world]
+    out = jnp.tensordot(w, gathered.astype(jnp.float32), axes=1)
+    return out.astype(jnp.asarray(x).dtype)
+
+
+def reduce_gradients(grads,
+                     axis_name: str,
+                     *,
+                     gradient_average: bool = True,
+                     gradient_predivide_factor: float = 1.0,
+                     allreduce_always_fp32: bool = False,
+                     axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+                     world_size: Optional[int] = None):
+    """All-reduce a gradient pytree across ``axis_name`` replicas.
+
+    Equivalent of ``allreduce_bucket`` (reference ``distributed.py:425-475``):
+    optional fp32 upcast, predivide by ``gradient_predivide_factor`` before
+    the reduce and postdivide by ``world/predivide`` after, so reduced-
+    precision sums stay in range.
+    """
+    if world_size is None:
+        world_size = lax.axis_size(axis_name)
+        if axis_index_groups:
+            world_size = len(axis_index_groups[0])
+
+    def one(g):
+        if not _is_float(g):
+            return g
+        orig_dtype = jnp.asarray(g).dtype
+        if allreduce_always_fp32:
+            g = jnp.asarray(g, jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = group_psum(g, axis_name, axis_index_groups)
+        if gradient_average:
+            postdiv = world_size / gradient_predivide_factor
+            if postdiv != 1.0:
+                g = g / postdiv
+        elif gradient_predivide_factor != 1.0:
+            g = g * gradient_predivide_factor
+        if allreduce_always_fp32:
+            g = g.astype(orig_dtype)
+        return g
+
+    return jax.tree_util.tree_map(one, grads)
+
+
+def broadcast_params(params, axis_name: str,
+                     root: int = 0,
+                     axis_index_groups=None):
+    """Make every replica's params equal to ``root``'s (reference ctor
+    broadcast, ``distributed.py:253``).  Implemented as mask+psum — the XLA
+    idiom for broadcast-from-rank."""
+    idx = lax.axis_index(axis_name)
+    mask = (idx == root).astype(jnp.float32)
+
+    def one(p):
+        if not _is_float(p):
+            return p
+        contrib = jnp.asarray(p, jnp.float32) * mask
+        return group_psum(contrib, axis_name, axis_index_groups).astype(
+            jnp.asarray(p).dtype)
+
+    return jax.tree_util.tree_map(one, params)
+
+
+class DistributedDataParallel:
+    """Object form carrying the DDP options (reference ctor flags).
+
+    ``message_size``, ``num_allreduce_streams`` and ``delay_allreduce`` are
+    accepted for API parity; on TPU message bucketing and stream scheduling
+    are XLA's responsibility, so they only affect bookkeeping (``delay_
+    allreduce`` is honored: reduction happens in ``reduce_gradients`` which
+    the caller invokes at the end of backward either way — there are no
+    per-param hooks to delay).
+    """
+
+    def __init__(self,
+                 module: Optional[Callable] = None,
+                 axis_name: str = "data",
+                 message_size: int = 10000000,
+                 delay_allreduce: bool = False,
+                 shared_param=None,
+                 allreduce_trigger_params=None,
+                 retain_allreduce_buffers: bool = False,
+                 allreduce_always_fp32: bool = False,
+                 num_allreduce_streams: int = 1,
+                 allreduce_communicators=None,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 axis_index_groups=None,
+                 prof: bool = False):
+        if shared_param is not None:
+            raise ValueError("shared_param is deprecated (reference parity: "
+                             "distributed.py:149-151); use delay_allreduce.")
+        self.module = module
+        self.axis_name = axis_name
+        self.delay_allreduce = delay_allreduce
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.axis_index_groups = axis_index_groups
+        self.retain_allreduce_buffers = retain_allreduce_buffers
+        self.prof = prof
+        self._disable_allreduce = False
+
+    # Forward passes through to the wrapped module (reference module wrapper).
+    def __call__(self, *args, **kwargs):
+        if self.module is None:
+            raise ValueError("DistributedDataParallel wraps no module")
+        return self.module(*args, **kwargs)
+
+    def sync_params(self, params, root: int = 0):
+        return broadcast_params(params, self.axis_name, root,
+                                self.axis_index_groups)
+
+    def reduce_gradients(self, grads):
+        if self._disable_allreduce:
+            return grads
+        scope = jax.named_scope("apex_tpu.ddp.allreduce")  # prof marker
+        with scope:
+            return reduce_gradients(
+                grads, self.axis_name,
+                gradient_average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                allreduce_always_fp32=self.allreduce_always_fp32,
+                axis_index_groups=self.axis_index_groups)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Disable grad reduction inside the context (reference
+        ``disable_allreduce`` flag, ``distributed.py:275-279``) — the grad
+        accumulation idiom.  Trace-time switch, like the reference's Python
+        flag."""
+        saved = self._disable_allreduce
+        self._disable_allreduce = True
+        try:
+            yield
+        finally:
+            self._disable_allreduce = saved
+
+    def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
+        """Return a grad_fn whose output grads are reduced — the "hook"
+        equivalent for functional code."""
+        def wrapped(*args, **kwargs):
+            out = grad_fn(*args, **kwargs)
+            if isinstance(out, tuple) and len(out) == 2:
+                value, grads = out
+                return value, self.reduce_gradients(grads)
+            return self.reduce_gradients(out)
+        return wrapped
+
+
+class Reducer:
+    """Manually-triggered allreduce of a param/grad tree (reference
+    ``Reducer``, ``distributed.py:89-126``)."""
+
+    def __init__(self, module_or_grads_list=None, axis_name: str = "data",
+                 axis_index_groups=None):
+        self.axis_name = axis_name
+        self.axis_index_groups = axis_index_groups
+        self.target = module_or_grads_list
+
+    def reduce(self, tree=None, average: bool = True):
+        tree = tree if tree is not None else self.target
+        return reduce_gradients(tree, self.axis_name,
+                                gradient_average=average,
+                                axis_index_groups=self.axis_index_groups)

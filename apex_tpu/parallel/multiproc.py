@@ -1,0 +1,56 @@
+"""Multi-process launcher (reference ``apex/parallel/multiproc.py:12-34``).
+
+On TPU pods the normal model is ONE process per host, each seeing its local
+chips, coordinated via ``jax.distributed.initialize`` — not N processes per
+device.  This launcher reproduces the reference's behavior for that model:
+spawn one worker per host entry, append ``--rank i``, set the JAX
+distributed env, and redirect rank>0 stdout to ``TPU_<i>.log``.
+
+Usage::
+
+    python -m apex_tpu.parallel.multiproc --nproc 2 train.py --args...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def docstring_hack():
+    """Multiproc file which will launch a set of processes locally for
+    multi-host training (reference docstring parity)."""
+    pass
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--nproc", type=int,
+                        default=int(os.environ.get("WORLD_SIZE", "1")))
+    parser.add_argument("--coordinator", type=str, default="127.0.0.1:12355")
+    args, rest = parser.parse_known_args(argv)
+
+    workers = []
+    for rank in range(args.nproc):
+        env = dict(os.environ,
+                   RANK=str(rank),
+                   WORLD_SIZE=str(args.nproc),
+                   JAX_COORDINATOR_ADDRESS=args.coordinator,
+                   JAX_NUM_PROCESSES=str(args.nproc),
+                   JAX_PROCESS_ID=str(rank))
+        cmd = [sys.executable] + rest + ["--rank", str(rank)]
+        stdout = None if rank == 0 else open("TPU_{}.log".format(rank), "w")
+        workers.append(subprocess.Popen(cmd, env=env, stdout=stdout))
+
+    rc = 0
+    for w in workers:
+        w.wait()
+        rc = rc or w.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,13 +2,16 @@
 
 SURVEY.md §4: the reference can only test distributed behavior on real
 multi-GPU nodes; the TPU build does better by unit-testing DP/SyncBN
-semantics on a virtual CPU mesh.  These env vars must be set before jax
+semantics on a virtual CPU mesh.  The XLA flag must be set before jax
 initializes its backends, hence the top-of-conftest placement.
+
+Note: the axon TPU plugin (if present) keeps "tpu" as the default backend
+even with JAX_PLATFORMS=cpu, so we pin the default *device* to cpu:0 and
+build test meshes from ``jax.devices("cpu")`` (see ``cpu_mesh``).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +20,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def cpu_mesh():
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices("cpu")[:8]), ("data",))
