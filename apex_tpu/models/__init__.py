@@ -1,0 +1,6 @@
+"""apex_tpu.models — benchmark model zoo (BASELINE.md configs)."""
+
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50,  # noqa: F401
+                     ResNet101, ResNet152, BottleneckBlock, BasicBlock)
+from .bert import BertEncoder, bert_base, bert_tiny         # noqa: F401
+from .dcgan import Generator, Discriminator                 # noqa: F401

@@ -1,0 +1,120 @@
+"""BERT encoder (flax, TPU-first) — the FusedAdam/FusedLAMB benchmark model.
+
+BASELINE.md config 4: BERT-base fine-tune with FusedAdam + FusedLAMB.  The
+reference has no model code (apex is a library); this is the standard
+transformer encoder built on apex_tpu components: ``FusedLayerNorm``
+(pallas), bf16 matmuls on the MXU, fp32 softmax/reductions — exactly the O1
+cast-list split, hard-wired where it matters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import jax
+
+from ..normalization import FusedLayerNorm
+
+
+class BertSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            (self.num_heads, head_dim), dtype=self.dtype,
+            param_dtype=jnp.float32, name=name)
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        # bf16 QK^T on the MXU, fp32 softmax (the cast-list split).
+        scores = jnp.einsum("...qhd,...khd->...hqk", q, k) / jnp.sqrt(
+            jnp.float32(head_dim)).astype(x.dtype)
+        scores = scores.astype(jnp.float32)
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("...hqk,...khd->...qhd", probs, v)
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
+                               param_dtype=jnp.float32, name="out")(ctx)
+
+
+class BertLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d = x.shape[-1]
+        attn = BertSelfAttention(self.num_heads, self.dtype,
+                                 name="attention")(x, mask)
+        x = FusedLayerNorm(normalized_shape=d, name="attention_ln")(
+            x + attn).astype(x.dtype)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="intermediate")(x)
+        h = nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
+        h = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="output")(h)
+        return FusedLayerNorm(normalized_shape=d, name="output_ln")(
+            x + h).astype(x.dtype)
+
+
+class BertEncoder(nn.Module):
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    type_vocab_size: int = 2
+    num_classes: Optional[int] = 2     # fine-tune head; None = features
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        b, s = input_ids.shape
+        emb = nn.Embed(self.vocab_size, self.hidden_size,
+                       param_dtype=jnp.float32, name="word_embeddings")(
+                           input_ids)
+        pos = nn.Embed(self.max_len, self.hidden_size,
+                       param_dtype=jnp.float32, name="position_embeddings")(
+                           jnp.arange(s)[None, :])
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        typ = nn.Embed(self.type_vocab_size, self.hidden_size,
+                       param_dtype=jnp.float32, name="token_type_embeddings")(
+                           token_type_ids)
+        x = FusedLayerNorm(normalized_shape=self.hidden_size,
+                           name="embeddings_ln")(emb + pos + typ)
+        x = x.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
+                          name=f"layer_{i}")(x, attention_mask)
+        if self.num_classes is None:
+            return x.astype(jnp.float32)
+        pooled = jnp.tanh(nn.Dense(self.hidden_size, dtype=self.dtype,
+                                   param_dtype=jnp.float32,
+                                   name="pooler")(x[:, 0]))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=jnp.float32, name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+def bert_base(**kw):
+    return BertEncoder(**kw)
+
+
+def bert_tiny(**kw):
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("mlp_dim", 512)
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("max_len", 128)
+    return BertEncoder(**kw)

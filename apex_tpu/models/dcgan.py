@@ -1,0 +1,61 @@
+"""DCGAN generator/discriminator (flax, NHWC) — the multi-model/multi-loss
+benchmark (BASELINE.md config 5; reference ``examples/dcgan/main_amp.py``
+exercises amp with 2 models, 2 optimizers, 3 loss scalers)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    ngf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        # z: [B, nz] -> [B, 4, 4, ngf*8] -> ... -> [B, 64, 64, nc]
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         dtype=self.dtype,
+                                         param_dtype=jnp.float32, name=name)
+        x = nn.Dense(4 * 4 * self.ngf * 8, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="project")(z)
+        x = x.reshape(z.shape[0], 4, 4, self.ngf * 8)
+        x = nn.relu(norm("bn0")(x))
+        for i, mult in enumerate((4, 2, 1)):
+            x = nn.ConvTranspose(self.ngf * mult, (4, 4), (2, 2),
+                                 padding="SAME", dtype=self.dtype,
+                                 param_dtype=jnp.float32,
+                                 name=f"deconv{i + 1}")(x)
+            x = nn.relu(norm(f"bn{i + 1}")(x))
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding="SAME",
+                             dtype=self.dtype, param_dtype=jnp.float32,
+                             name="deconv_out")(x)
+        return jnp.tanh(x.astype(jnp.float32))
+
+
+class Discriminator(nn.Module):
+    ndf: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         dtype=self.dtype,
+                                         param_dtype=jnp.float32, name=name)
+        x = x.astype(self.dtype)
+        x = nn.leaky_relu(nn.Conv(self.ndf, (4, 4), (2, 2), padding="SAME",
+                                  dtype=self.dtype, param_dtype=jnp.float32,
+                                  name="conv1")(x), 0.2)
+        for i, mult in enumerate((2, 4, 8)):
+            x = nn.Conv(self.ndf * mult, (4, 4), (2, 2), padding="SAME",
+                        dtype=self.dtype, param_dtype=jnp.float32,
+                        name=f"conv{i + 2}")(x)
+            x = nn.leaky_relu(norm(f"bn{i + 2}")(x), 0.2)
+        x = jnp.mean(x, axis=(1, 2))
+        logit = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32,
+                         name="head")(x)
+        return logit.astype(jnp.float32)

@@ -87,8 +87,24 @@ def reduce_gradients(grads,
         if axis_index_groups:
             world_size = len(axis_index_groups[0])
 
+    def _already_reduced(g) -> bool:
+        """shard_map autodiff inserts the psum itself when differentiating
+        w.r.t. replicated params (the transpose of the implicit broadcast),
+        so such grads arrive already *summed* over the axis.  They carry an
+        empty varying-manual-axes (vma) set; axis-varying grads (per-shard
+        values, e.g. under pmap-style code) still need the collective."""
+        try:
+            vma = jax.typeof(g).vma
+        except AttributeError:
+            return False
+        return axis_name not in vma
+
     def one(g):
         if not _is_float(g):
+            return g
+        if _already_reduced(g):
+            if gradient_average:
+                return (g / world_size).astype(jnp.asarray(g).dtype)
             return g
         orig_dtype = jnp.asarray(g).dtype
         if allreduce_always_fp32:

@@ -99,6 +99,8 @@ class SyncBatchNorm(nn.Module):
     channel_last: bool = True
     fuse_relu: bool = False
     use_running_average: Optional[bool] = None
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
 
     @nn.compact
     def __call__(self, x, z=None, use_running_average: Optional[bool] = None):
@@ -144,9 +146,9 @@ class SyncBatchNorm(nn.Module):
         out = (x.astype(jnp.float32)
                - mean.reshape(stat_shape)) * invstd.reshape(stat_shape)
         if self.affine:
-            weight = self.param("scale", nn.initializers.ones,
+            weight = self.param("scale", self.scale_init,
                                 (num_features,), jnp.float32)
-            bias = self.param("bias", nn.initializers.zeros,
+            bias = self.param("bias", self.bias_init,
                               (num_features,), jnp.float32)
             out = out * weight.reshape(stat_shape) + bias.reshape(stat_shape)
         if z is not None:

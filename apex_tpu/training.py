@@ -1,0 +1,189 @@
+"""Fully-jitted amp training steps — the TPU-idiomatic path.
+
+The reference's training iteration is an imperative choreography of hooks and
+patched methods (SURVEY.md §3.2).  On TPU the whole iteration — input cast,
+bf16 forward, backward, gradient all-reduce, unscale + overflow flag, the
+loss-scale state machine, and the skip-masked optimizer update — compiles
+into ONE XLA program.  ``make_train_step`` builds that program from the same
+opt-level semantics as ``amp.initialize``:
+
+* O0: fp32 end to end.
+* O1: autocast policy active inside the traced loss (enable via
+  ``amp.init()``); params fp32.
+* O2: params stored ONCE as fp32 masters; the bf16 model copy exists only
+  *inside* the step (cast at trace time, keep-norm-fp32 honored) — this is
+  the master-weights design with zero duplicate storage, the TPU-first
+  answer to ``_process_optimizer``'s master machinery.
+* O3: params stored bf16, no masters.
+
+Step skipping is a device-side select (``apply_mask``), so dynamic loss
+scaling costs no host sync at all (the reference pays one D2H per step,
+``scaler.py:199-200``).
+
+Usage::
+
+    tx = apex_tpu.training.adam(lr=1e-3)
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level="O2",
+                                       axis_name="data")
+    state = init_fn(params)
+    state, metrics = jax.jit(step_fn)(state, batch)       # single chip
+    # or shard_map(step_fn, mesh, ...) for DP over a mesh axis
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .amp import policy as _policy
+from .amp.loss_scaler import LossScaler, LossScalerState
+from .amp.properties import opt_levels
+from .optimizers import functional as F
+from .parallel.distributed import reduce_gradients
+
+
+class FunctionalOptimizer(NamedTuple):
+    init: Callable
+    update: Callable      # (grads, state, params, lr, grad_scale, apply_mask)
+
+
+def adam(lr=1e-3, **kw) -> FunctionalOptimizer:
+    return FunctionalOptimizer(
+        F.adam_init, functools.partial(F.adam_update, lr=lr, **kw))
+
+
+def sgd(lr=1e-3, momentum=0.0, **kw) -> FunctionalOptimizer:
+    return FunctionalOptimizer(
+        functools.partial(F.sgd_init, momentum=momentum),
+        functools.partial(F.sgd_update, lr=lr, momentum=momentum, **kw))
+
+
+def lamb(lr=1e-3, **kw) -> FunctionalOptimizer:
+    return FunctionalOptimizer(
+        F.lamb_init, functools.partial(F.lamb_update, lr=lr, **kw))
+
+
+def novograd(lr=1e-3, **kw) -> FunctionalOptimizer:
+    return FunctionalOptimizer(
+        F.novograd_init, functools.partial(F.novograd_update, lr=lr, **kw))
+
+
+class TrainState(NamedTuple):
+    """Carry of the jitted step.  ``params`` is the single source of truth:
+    fp32 for O0/O1/O2 (O2 casts inside the step), bf16 for O3."""
+    params: Any
+    opt_state: Any
+    scaler: LossScalerState
+    model_state: Any      # batch_stats etc; None if unused
+
+
+def make_train_step(loss_fn: Callable,
+                    optimizer: FunctionalOptimizer,
+                    *,
+                    opt_level: str = "O2",
+                    loss_scale=None,
+                    keep_batchnorm_fp32: Optional[bool] = None,
+                    cast_model_type=None,
+                    axis_name: Optional[str] = None,
+                    gradient_average: bool = True,
+                    gradient_predivide_factor: float = 1.0,
+                    allreduce_always_fp32: bool = False,
+                    axis_index_groups=None,
+                    norm_predicate=None,
+                    has_model_state: bool = False,
+                    scale_window: int = 2000,
+                    min_loss_scale=None,
+                    max_loss_scale: float = 2.**24):
+    """Build ``(init_fn, step_fn)`` for one amp training step.
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)`` when
+    ``has_model_state`` else ``loss_fn(params, batch) -> loss``.  Inside the
+    step, ``params`` arrive already cast to the compute dtype per opt level.
+    """
+    props = opt_levels[opt_level]()
+    if loss_scale is not None:
+        props.loss_scale = loss_scale
+    if keep_batchnorm_fp32 is not None:
+        props.keep_batchnorm_fp32 = keep_batchnorm_fp32
+    if cast_model_type is not None:
+        props.cast_model_type = cast_model_type
+
+    scaler = LossScaler(props.loss_scale, scale_window=scale_window,
+                        min_loss_scale=min_loss_scale,
+                        max_loss_scale=max_loss_scale)
+    dynamic = scaler.dynamic
+
+    cast_dtype = props.cast_model_type
+    cast_in_step = (cast_dtype is not None
+                    and jnp.dtype(cast_dtype) != jnp.dtype(jnp.float32)
+                    and props.master_weights)
+    store_dtype_cast = (cast_dtype is not None
+                        and jnp.dtype(cast_dtype) != jnp.dtype(jnp.float32)
+                        and not props.master_weights)
+    keep_bn = props.keep_batchnorm_fp32
+    keep_bn = True if keep_bn is None else keep_bn
+
+    def compute_cast(params):
+        if cast_in_step:
+            return _policy.convert_params(params, cast_dtype,
+                                          keep_norm_fp32=keep_bn,
+                                          norm_predicate=norm_predicate)
+        return params
+
+    def init_fn(params, model_state=None):
+        if store_dtype_cast:  # O3: store reduced precision, no masters
+            params = _policy.convert_params(params, cast_dtype,
+                                            keep_norm_fp32=keep_bn,
+                                            norm_predicate=norm_predicate)
+        return TrainState(params=params,
+                          opt_state=optimizer.init(params),
+                          scaler=scaler.init(),
+                          model_state=model_state)
+
+    def step_fn(state: TrainState, batch):
+        def scaled_loss(p):
+            cp = compute_cast(p)
+            if has_model_state:
+                loss, new_ms = loss_fn(cp, state.model_state, batch)
+            else:
+                loss = loss_fn(cp, batch)
+                new_ms = state.model_state
+            return (jnp.asarray(loss, jnp.float32)
+                    * state.scaler.loss_scale), (loss, new_ms)
+
+        grads, (loss, new_ms) = jax.grad(scaled_loss, has_aux=True)(
+            state.params)
+
+        if axis_name is not None:
+            grads = reduce_gradients(
+                grads, axis_name,
+                gradient_average=gradient_average,
+                gradient_predivide_factor=gradient_predivide_factor,
+                allreduce_always_fp32=allreduce_always_fp32,
+                axis_index_groups=axis_index_groups)
+
+        grads, scaler_state = scaler.unscale(grads, state.scaler)
+        if dynamic:
+            apply_mask = jnp.logical_not(scaler_state.overflow)
+        else:
+            apply_mask = None
+        new_params, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params, apply_mask=apply_mask)
+        scaler_state = scaler.update_scale(scaler_state)
+
+        if axis_name is not None:
+            # Replicated metric, like the reference examples' allreduced
+            # loss prints (main_amp.py:356-394).
+            loss = jax.lax.pmean(loss, axis_name)
+        metrics = {"loss": loss,
+                   "loss_scale": scaler_state.loss_scale,
+                   "overflow": (jnp.logical_not(apply_mask)
+                                if apply_mask is not None
+                                else jnp.asarray(False))}
+        return TrainState(params=new_params, opt_state=new_opt_state,
+                          scaler=scaler_state, model_state=new_ms), metrics
+
+    return init_fn, step_fn
