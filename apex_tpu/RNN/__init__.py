@@ -1,0 +1,6 @@
+"""apex_tpu.RNN — scan-based RNN stack (reference ``apex/RNN``)."""
+
+from .models import LSTM, GRU, ReLU, Tanh, mLSTM          # noqa: F401
+from .models import stackedRNN, bidirectionalRNN          # noqa: F401
+from .cells import (LSTMCell, GRUCell, RNNReLUCell,       # noqa: F401
+                    RNNTanhCell, mLSTMCell)

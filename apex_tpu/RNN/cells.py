@@ -1,0 +1,121 @@
+"""RNN cells as flax modules — one step: ``(carry, x) -> (carry, out)``.
+
+Re-design of reference ``apex/RNN/cells.py`` + the cell zoo consumed by
+``apex/RNN/RNNBackend.py:232-365`` (torch ``LSTMCell``/``GRUCell``/
+``RNNReLUCell``/``RNNTanhCell`` + the multiplicative ``mLSTMCell``
+``cells.py:12-81``).  The reference relies on cuDNN fused pointwise kernels;
+under XLA the gate math fuses automatically, and the time loop is
+``lax.scan`` (see models.py) so the whole sequence compiles to one program.
+
+Gate matmuls run in the module dtype (bf16 on TPU → MXU); the cell state is
+carried in fp32 for additive stability, matching the reference's fp32
+hidden-state init (RNNBackend.py:309-328).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _dense(features, use_bias, dtype, name):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype,
+                    param_dtype=jnp.float32, name=name)
+
+
+class RNNReLUCell(nn.Module):
+    """h' = relu(W_ih x + W_hh h + b)."""
+    hidden_size: int
+    bias: bool = True
+    dtype: Any = jnp.float32
+    act = staticmethod(nn.relu)
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        g = (_dense(self.hidden_size, self.bias, self.dtype, "ih")(x)
+             + _dense(self.hidden_size, self.bias, self.dtype, "hh")(
+                 h.astype(self.dtype)))
+        h = self.act(g).astype(jnp.float32)
+        return (h,), h
+
+    @staticmethod
+    def n_hidden_states():
+        return 1
+
+
+class RNNTanhCell(RNNReLUCell):
+    act = staticmethod(nn.tanh)
+
+
+class LSTMCell(nn.Module):
+    hidden_size: int
+    bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        gates = (_dense(4 * self.hidden_size, self.bias, self.dtype, "ih")(x)
+                 + _dense(4 * self.hidden_size, self.bias, self.dtype, "hh")(
+                     h.astype(self.dtype)))
+        i, f, g, o = jnp.split(gates.astype(jnp.float32), 4, axis=-1)
+        c = nn.sigmoid(f) * c + nn.sigmoid(i) * nn.tanh(g)
+        h = nn.sigmoid(o) * nn.tanh(c)
+        return (h, c), h
+
+    @staticmethod
+    def n_hidden_states():
+        return 2
+
+
+class GRUCell(nn.Module):
+    hidden_size: int
+    bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        hd = h.astype(self.dtype)
+        ri = _dense(2 * self.hidden_size, self.bias, self.dtype, "ih_rz")(x)
+        rh = _dense(2 * self.hidden_size, self.bias, self.dtype, "hh_rz")(hd)
+        r, z = jnp.split(nn.sigmoid((ri + rh).astype(jnp.float32)), 2, axis=-1)
+        n = nn.tanh(
+            _dense(self.hidden_size, self.bias, self.dtype, "ih_n")(x)
+            .astype(jnp.float32)
+            + r * _dense(self.hidden_size, self.bias, self.dtype, "hh_n")(hd)
+            .astype(jnp.float32))
+        h = (1.0 - z) * n + z * h
+        return (h,), h
+
+    @staticmethod
+    def n_hidden_states():
+        return 1
+
+
+class mLSTMCell(nn.Module):
+    """Multiplicative LSTM (reference ``mLSTMCell`` cells.py:55-81):
+    ``m = (W_mih x) * (W_mhh h)``; gates = ``W_ih x + W_hh m``."""
+    hidden_size: int
+    bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        hd = h.astype(self.dtype)
+        m = (_dense(self.hidden_size, False, self.dtype, "mih")(x)
+             * _dense(self.hidden_size, False, self.dtype, "mhh")(hd))
+        gates = (_dense(4 * self.hidden_size, self.bias, self.dtype, "ih")(x)
+                 + _dense(4 * self.hidden_size, self.bias, self.dtype, "hh")(m))
+        i, f, g, o = jnp.split(gates.astype(jnp.float32), 4, axis=-1)
+        c = nn.sigmoid(f) * c + nn.sigmoid(i) * nn.tanh(g)
+        h = nn.sigmoid(o) * nn.tanh(c)
+        return (h, c), h
+
+    @staticmethod
+    def n_hidden_states():
+        return 2

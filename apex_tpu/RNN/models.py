@@ -1,0 +1,159 @@
+"""Stacked / bidirectional RNNs with factory functions.
+
+Re-design of reference ``apex/RNN/models.py:20-54`` (LSTM/GRU/ReLU/Tanh/
+mLSTM factories) and ``apex/RNN/RNNBackend.py`` (``stackedRNN:90-231``,
+``bidirectionalRNN:25-88``).  The reference loops over time steps in Python
+with per-module mutable hidden state; here the time loop is ``nn.scan``
+(→ ``lax.scan``, one compiled loop, static shapes, TPU-friendly) and hidden
+state is explicit — pass ``initial_states`` and get final states back, the
+functional version of ``init_hidden``/``detach_hidden``/``reset_hidden``.
+
+Layout: time-major ``[T, B, F]`` like the reference (``batch_first=True``
+transposes at the boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .cells import GRUCell, LSTMCell, RNNReLUCell, RNNTanhCell, mLSTMCell
+
+
+class stackedRNN(nn.Module):
+    """num_layers cells stacked, scanned over time (reference
+    ``stackedRNN.forward`` RNNBackend.py:122-196, incl. inter-layer
+    dropout and the reverse flag used by the bidirectional wrapper)."""
+    cell_cls: Type[nn.Module]
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dropout: float = 0.0
+    output_size: Optional[int] = None
+    batch_first: bool = False
+    dtype: Any = jnp.float32
+
+    def _zero_carry(self, bsz):
+        n = self.cell_cls.n_hidden_states()
+        zeros = jnp.zeros((bsz, self.hidden_size), jnp.float32)
+        return tuple(zeros for _ in range(n))
+
+    @nn.compact
+    def __call__(self, inputs, initial_states: Optional[Sequence] = None,
+                 *, reverse: bool = False, train: bool = False,
+                 collect_hidden: bool = False):
+        """``inputs`` [T,B,F] (or [B,T,F] if batch_first).  Returns
+        ``(outputs, final_states)`` — outputs [T,B,H], final_states a list
+        of per-layer carries (hy[, cy])."""
+        if self.batch_first:
+            inputs = jnp.swapaxes(inputs, 0, 1)
+        if reverse:
+            inputs = jnp.flip(inputs, axis=0)
+        bsz = inputs.shape[1]
+        if initial_states is None:
+            initial_states = [self._zero_carry(bsz)
+                              for _ in range(self.num_layers)]
+
+        scan = nn.scan(
+            lambda cell, carry, x: cell(carry, x),
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0, out_axes=0)
+
+        x = inputs
+        finals = []
+        for layer in range(self.num_layers):
+            cell = self.cell_cls(hidden_size=self.hidden_size,
+                                 bias=self.bias, dtype=self.dtype,
+                                 name=f"layer{layer}")
+            carry, x = scan(cell, tuple(initial_states[layer]), x)
+            finals.append(carry)
+            if self.dropout > 0 and train and layer < self.num_layers - 1:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        if self.output_size is not None and self.output_size != self.hidden_size:
+            # reference RNNCell w_ho projection (RNNBackend.py:264-271, :348+)
+            x = nn.Dense(self.output_size, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="proj")(x)
+        if reverse:
+            x = jnp.flip(x, axis=0)
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        return x, finals
+
+
+class bidirectionalRNN(nn.Module):
+    """Forward + reverse stacks, feature-concatenated (reference
+    ``bidirectionalRNN`` RNNBackend.py:25-88)."""
+    cell_cls: Type[nn.Module]
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    dropout: float = 0.0
+    output_size: Optional[int] = None
+    batch_first: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs, initial_states=None, *, train: bool = False):
+        kw = dict(cell_cls=self.cell_cls, hidden_size=self.hidden_size,
+                  num_layers=self.num_layers, bias=self.bias,
+                  dropout=self.dropout, output_size=self.output_size,
+                  batch_first=self.batch_first, dtype=self.dtype)
+        fwd_init = rev_init = None
+        if initial_states is not None:
+            fwd_init, rev_init = initial_states
+        out_f, fin_f = stackedRNN(**kw, name="fwd")(
+            inputs, fwd_init, train=train)
+        out_r, fin_r = stackedRNN(**kw, name="bwd")(
+            inputs, rev_init, reverse=True, train=train)
+        return jnp.concatenate([out_f, out_r], axis=-1), (fin_f, fin_r)
+
+
+def _factory(cell_cls, input_size, hidden_size, num_layers, bias=True,
+             batch_first=False, dropout=0.0, bidirectional=False,
+             output_size=None, dtype=jnp.float32):
+    # input_size is inferred from data by flax; kept as an arg for reference
+    # signature parity (models.py:19-54).
+    del input_size
+    cls = bidirectionalRNN if bidirectional else stackedRNN
+    return cls(cell_cls=cell_cls, hidden_size=hidden_size,
+               num_layers=num_layers, bias=bias, dropout=dropout,
+               output_size=output_size, batch_first=batch_first, dtype=dtype)
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None,
+         dtype=jnp.float32):
+    return _factory(LSTMCell, input_size, hidden_size, num_layers, bias,
+                    batch_first, dropout, bidirectional, output_size, dtype)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False, output_size=None,
+        dtype=jnp.float32):
+    return _factory(GRUCell, input_size, hidden_size, num_layers, bias,
+                    batch_first, dropout, bidirectional, output_size, dtype)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None,
+         dtype=jnp.float32):
+    return _factory(RNNReLUCell, input_size, hidden_size, num_layers, bias,
+                    batch_first, dropout, bidirectional, output_size, dtype)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None,
+         dtype=jnp.float32):
+    return _factory(RNNTanhCell, input_size, hidden_size, num_layers, bias,
+                    batch_first, dropout, bidirectional, output_size, dtype)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+          dropout=0.0, bidirectional=False, output_size=None,
+          dtype=jnp.float32):
+    return _factory(mLSTMCell, input_size, hidden_size, num_layers, bias,
+                    batch_first, dropout, bidirectional, output_size, dtype)

@@ -1,0 +1,16 @@
+"""apex_tpu.bf16_utils — the legacy manual mixed-precision API.
+
+TPU-native re-design of reference ``apex/fp16_utils/`` (fp16util.py,
+fp16_optimizer.py, loss_scaler.py).  On TPU the reduced precision is
+bfloat16, so this package is named ``bf16_utils``; ``apex_tpu.fp16_utils``
+is an alias so reference user code imports keep working.
+"""
+
+from .bf16util import (  # noqa: F401
+    to_bf16, to_half, BN_convert_float, network_to_half, convert_module,
+    convert_network, BF16Model, FP16Model, prep_param_lists,
+    model_grads_to_master_grads, master_params_to_model_params,
+    clip_grad_norm,
+)
+from .loss_scaler import LossScaler, DynamicLossScaler   # noqa: F401
+from .fp16_optimizer import FP16_Optimizer               # noqa: F401

@@ -1,0 +1,165 @@
+"""Manual precision-conversion helpers over parameter pytrees.
+
+Re-design of reference ``apex/fp16_utils/fp16util.py:7-187``.  There,
+"convert the network" mutates ``nn.Module`` objects in place; here models
+are (apply_fn, params) pairs, so every helper is a pure function over a
+pytree or a thin wrapper returning a new apply_fn.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..amp import policy as _policy
+from ..multi_tensor import multi_tensor_l2norm, multi_tensor_scale
+
+
+def _is_float(x):
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def to_bf16(value):
+    """Cast every floating leaf to bfloat16 (reference ``tofp16`` module,
+    fp16util.py:7-15 — a module that halves its input)."""
+    return _policy.to_type(jnp.bfloat16, value)
+
+
+#: fp16 name kept for drop-in reference compatibility; on TPU "half" = bf16.
+to_half = to_bf16
+
+
+def BN_convert_float(params, norm_predicate=None):
+    """Return ``params`` with normalization-layer leaves cast back to fp32
+    (reference ``BN_convert_float`` fp16util.py:17-32: BatchNorm modules with
+    affine params revert to float for cuDNN; here the constraint is numeric
+    only — norm scale/bias stay fp32 for stable statistics)."""
+    pred = norm_predicate or _policy.default_norm_predicate
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    out = [x.astype(jnp.float32)
+           if _is_float(x) and pred(_policy._path_str(path)) else x
+           for path, x in flat]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def convert_module(params, dtype):
+    """Cast every floating leaf to ``dtype`` (reference ``convert_module``
+    fp16util.py:34-52, minus the buffer special cases that don't exist in a
+    pytree world)."""
+    return _policy.to_type(dtype, params)
+
+
+def convert_network(params, dtype, norm_predicate=None):
+    """Cast the model to ``dtype`` keeping norm affine params fp32 —
+    reference ``convert_network`` fp16util.py:74-86, the exact routine amp O2
+    uses (``_initialize.py:173-176``)."""
+    return _policy.convert_params(params, dtype, keep_norm_fp32=True,
+                                  norm_predicate=norm_predicate)
+
+
+def network_to_half(apply_fn: Callable, params) -> Tuple[Callable, Any]:
+    """Return ``(bf16_apply_fn, bf16_params)``: inputs are cast to bf16 on the
+    way in and the computation runs in bf16 (reference ``network_to_half``
+    fp16util.py:54-61 = ``Sequential(tofp16(), network.half())``)."""
+    new_params = convert_network(params, jnp.bfloat16)
+
+    def bf16_apply(p, *args, **kwargs):
+        args = _policy.to_type(jnp.bfloat16, args)
+        return apply_fn(p, *args, **kwargs)
+
+    return bf16_apply, new_params
+
+
+class BF16Model:
+    """Callable bundling a bf16-converted network (reference ``FP16Model``
+    fp16util.py:88-102)."""
+
+    def __init__(self, apply_fn: Callable, params):
+        self.apply_fn, self.params = network_to_half(apply_fn, params)
+
+    def __call__(self, *args, **kwargs):
+        return self.apply_fn(self.params, *args, **kwargs)
+
+
+FP16Model = BF16Model
+
+
+def prep_param_lists(params, flat_master: bool = False):
+    """Return ``(model_params, master_params)`` — fp32 master copies of the
+    model's (possibly bf16) params (reference ``prep_param_lists``
+    fp16util.py:104-134).
+
+    With ``flat_master=True`` the master is ONE flat fp32 vector (reference
+    flattens via ``_flatten_dense_tensors``); here we concatenate raveled
+    leaves — XLA fuses the unflatten-copy back, so the flat form costs
+    nothing extra on TPU and gives O(1)-launch full-model ops.
+    """
+    if flat_master:
+        leaves = [x.astype(jnp.float32).ravel()
+                  for x in jax.tree_util.tree_leaves(params) if _is_float(x)]
+        master = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+        return params, master
+    master = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32) if _is_float(x) else x, params)
+    return params, master
+
+
+def _unflatten_like(flat, tree):
+    """Split a flat vector back into the float-leaf structure of ``tree``."""
+    flat_leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, off = [], 0
+    for x in flat_leaves:
+        if _is_float(x):
+            n = x.size
+            out.append(flat[off:off + n].reshape(x.shape))
+            off += n
+        else:
+            out.append(x)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def model_grads_to_master_grads(model_grads, flat_master: bool = False):
+    """bf16 model grads → fp32 master grads (reference fp16util.py:136-156).
+    Returns the fp32 grad pytree (or flat vector)."""
+    if flat_master:
+        leaves = [g.astype(jnp.float32).ravel()
+                  for g in jax.tree_util.tree_leaves(model_grads)
+                  if _is_float(g)]
+        return jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+    out, _ = multi_tensor_scale(model_grads, 1.0, out_dtype=jnp.float32)
+    return out
+
+
+def master_params_to_model_params(model_params, master_params,
+                                  flat_master: bool = False):
+    """fp32 masters → model-dtype params (reference fp16util.py:158-173);
+    returns the updated model param pytree."""
+    if flat_master:
+        master_tree = _unflatten_like(master_params, model_params)
+    else:
+        master_tree = master_params
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype) if _is_float(p) else p,
+        master_tree, model_params)
+
+
+def clip_grad_norm(grads, max_norm, norm_type: float = 2.0):
+    """Global-norm clip over the grad pytree; returns ``(clipped_grads,
+    total_norm)``.  Reference aliases ``torch.nn.utils.clip_grad_norm``
+    (fp16util.py:180-187); FP16_Optimizer.clip_master_grads uses it."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if _is_float(g)]
+    if norm_type == 2.0:
+        total = multi_tensor_l2norm(grads)
+    elif norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in leaves])) ** (1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g * scale).astype(g.dtype) if _is_float(g) else g, grads)
+    return clipped, total

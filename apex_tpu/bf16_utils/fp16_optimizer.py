@@ -1,0 +1,158 @@
+"""FP16_Optimizer — the general legacy master-weight wrapper.
+
+Re-design of reference ``apex/fp16_utils/fp16_optimizer.py:13-643``: wraps
+any ``apex_tpu.optimizers.FusedOptimizer`` (or a functional optimizer pair)
+with fp32 master weights, manual loss scaling, overflow skip-step, and
+gradient clipping.
+
+Reference flow preserved:
+
+* ``backward(grads)``  — deliver grads of the *scaled* loss; fused
+  scale-and-copy into fp32 master grads with device-side overflow flag
+  (reference ``backward`` :462-524 + ``update_master_grads`` :525-580).
+* ``step()``           — skip on overflow, update dynamic scale
+  (reference :361-422).
+* ``clip_master_grads(max_norm)`` (reference :424-446).
+* ``state_dict``/``load_state_dict`` incl. scaler state (reference :448-512).
+
+The TPU-first difference: masters are the single fp32 source of truth and
+model params are a cast view produced after each step — no flat-buffer
+machinery, XLA fuses the whole update into one program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..amp.loss_scaler import all_finite
+from .bf16util import (clip_grad_norm, master_params_to_model_params,
+                       model_grads_to_master_grads, prep_param_lists)
+from .loss_scaler import DynamicLossScaler, LossScaler
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = True):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self.first_closure_call_this_step = True
+        self.verbose = verbose
+
+        # fp32 masters shadow the (possibly bf16) model params.
+        self.model_params, self.master_params = prep_param_lists(
+            init_optimizer.params)
+        # The wrapped optimizer updates the masters.
+        self.optimizer.params = self.master_params
+        self.optimizer.state = self.optimizer._init_state(self.master_params)
+        self._master_grads = None
+
+    # -- loss / backward ----------------------------------------------------
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    def scale_loss(self, loss):
+        """Multiply the loss by the current scale (use inside your grad fn);
+        the reference's ``backward(loss)`` does ``loss*scale`` then
+        ``.backward()`` (:473-495)."""
+        return jnp.asarray(loss, jnp.float32) * self.loss_scaler.loss_scale
+
+    def backward(self, model_grads, update_master_grads: bool = True):
+        """Deliver grads of the scaled loss w.r.t. the *model* params."""
+        self._model_grads = model_grads
+        if update_master_grads:
+            self.update_master_grads()
+
+    def update_master_grads(self):
+        """Unscale model grads into fp32 master grads; set ``self.overflow``
+        (reference ``update_master_grads`` :525-580 — fused
+        multi_tensor_scale path when available)."""
+        grads = self._model_grads
+        self.overflow = self.loss_scaler.has_overflow(grads) \
+            if isinstance(self.loss_scaler, DynamicLossScaler) else False
+        inv = 1.0 / self.loss_scaler.loss_scale
+        master_grads = model_grads_to_master_grads(grads)
+        self._master_grads = jax.tree_util.tree_map(
+            lambda g: g * inv, master_grads)
+
+    def clip_master_grads(self, max_norm, norm_type=2.0):
+        """Clip fp32 master grads by global norm; returns the pre-clip norm
+        (reference :424-446)."""
+        if self._master_grads is None:
+            return 0.0
+        self._master_grads, total = clip_grad_norm(
+            self._master_grads, max_norm, norm_type)
+        return float(jax.device_get(total))
+
+    # -- step ---------------------------------------------------------------
+    def step(self, closure=None):
+        if closure is not None:
+            closure()
+        if self.overflow:
+            if self.verbose:
+                print("OVERFLOW! Skipping step. Reducing loss scale to "
+                      f"{self.loss_scaler.loss_scale / self.loss_scaler.scale_factor}")
+            self.loss_scaler.update_scale(True)
+            self._master_grads = None
+            return
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            self.loss_scaler.update_scale(False)
+        self.optimizer.step(grads=self._master_grads)
+        self.master_params = self.optimizer.params
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params)
+        self._master_grads = None
+
+    def zero_grad(self, set_grads_to_None: bool = True):
+        self._master_grads = None
+        self._model_grads = None
+
+    # -- checkpointing ------------------------------------------------------
+    def state_dict(self):
+        sd = {
+            "loss_scaler_scale": self.loss_scaler.loss_scale,
+            "dynamic": isinstance(self.loss_scaler, DynamicLossScaler),
+            "overflow": self.overflow,
+            "first_closure_call_this_step": self.first_closure_call_this_step,
+            "optimizer_state_dict": self.optimizer.state_dict(),
+            "master_params": jax.device_get(self.master_params),
+        }
+        if sd["dynamic"]:
+            sd["cur_iter"] = self.loss_scaler.cur_iter
+            sd["last_overflow_iter"] = self.loss_scaler.last_overflow_iter
+        return sd
+
+    def load_state_dict(self, sd):
+        if sd["dynamic"] and isinstance(self.loss_scaler, DynamicLossScaler):
+            self.loss_scaler.cur_scale = sd["loss_scaler_scale"]
+            self.loss_scaler.cur_iter = sd["cur_iter"]
+            self.loss_scaler.last_overflow_iter = sd["last_overflow_iter"]
+        elif not sd["dynamic"]:
+            self.loss_scaler.cur_scale = sd["loss_scaler_scale"]
+        self.overflow = sd["overflow"]
+        self.first_closure_call_this_step = sd["first_closure_call_this_step"]
+        self.optimizer.load_state_dict(sd["optimizer_state_dict"])
+        self.master_params = jax.tree_util.tree_map(
+            jnp.asarray, sd["master_params"])
+        self.optimizer.params = self.master_params
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params)
+
+    # Reference property passthroughs (:586-643).
+    @property
+    def state(self):
+        return self.optimizer.state
+
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups

@@ -1,0 +1,81 @@
+"""Legacy loss scalers (the pre-amp manual API).
+
+Re-design of reference ``apex/fp16_utils/loss_scaler.py``:
+
+* ``LossScaler`` — static scale, overflow check is a no-op (:10-44).
+* ``DynamicLossScaler`` — init 2**32, halve on overflow, double after 1000
+  clean iterations (:46-131).
+
+Overflow detection is a device-side all-finite reduction (the reference's
+``_has_inf_or_nan`` does a per-param CPU float sum, :94-113 — on TPU that
+would be a host sync per tensor; we reduce on device and sync once).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..amp.loss_scaler import all_finite
+
+
+class LossScaler:
+    """Static loss scaler (reference loss_scaler.py:10-44)."""
+
+    def __init__(self, scale=1.0):
+        self.cur_scale = float(scale)
+
+    def has_overflow(self, params_or_grads):
+        return False
+
+    def _has_inf_or_nan(self, x):
+        return False
+
+    def update_scale(self, overflow):
+        pass
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(lambda g: g * self.cur_scale, grads)
+
+    def backward(self, loss_grad_fn, *args):
+        """Return grads of ``loss * scale`` given a grad fn of the raw loss."""
+        grads = loss_grad_fn(*args)
+        return self.scale_gradient(grads)
+
+
+class DynamicLossScaler:
+    """Dynamic loss scaler (reference loss_scaler.py:46-131): init 2**32,
+    ``scale_factor`` 2, ``scale_window`` 1000."""
+
+    def __init__(self, init_scale=2.**32, scale_factor=2., scale_window=1000):
+        self.cur_scale = float(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, params_or_grads) -> bool:
+        """ONE device→host sync for the whole tree."""
+        return not bool(jax.device_get(all_finite(params_or_grads)))
+
+    def _has_inf_or_nan(self, x) -> bool:
+        return not bool(jax.device_get(jnp.all(jnp.isfinite(x))))
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(lambda g: g * self.cur_scale, grads)

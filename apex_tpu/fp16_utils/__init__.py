@@ -1,0 +1,10 @@
+"""apex_tpu.fp16_utils — alias of :mod:`apex_tpu.bf16_utils` for reference
+API compatibility (``apex/fp16_utils``): on TPU "fp16" means bfloat16."""
+
+from ..bf16_utils import *            # noqa: F401,F403
+from ..bf16_utils import (            # noqa: F401
+    to_bf16, to_half, BN_convert_float, network_to_half, convert_module,
+    convert_network, BF16Model, FP16Model, prep_param_lists,
+    model_grads_to_master_grads, master_params_to_model_params,
+    clip_grad_norm, LossScaler, DynamicLossScaler, FP16_Optimizer,
+)

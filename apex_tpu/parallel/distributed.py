@@ -82,10 +82,21 @@ def reduce_gradients(grads,
     the reduce and postdivide by ``world/predivide`` after, so reduced-
     precision sums stay in range.
     """
+    full_world = lax.axis_size(axis_name)
     if world_size is None:
-        world_size = lax.axis_size(axis_name)
+        world_size = full_world
         if axis_index_groups:
             world_size = len(axis_index_groups[0])
+
+    # Whether varying-manual-axes tracking is live on this trace: under
+    # shard_map(check_vma=False) every aval reports an empty vma set, which
+    # must NOT be read as "already reduced" — there the implicit-broadcast
+    # transpose does not insert a psum either, so grads arrive per-shard.
+    # axis_index is axis-varying by construction, so it probes tracking.
+    try:
+        _vma_tracking = axis_name in jax.typeof(lax.axis_index(axis_name)).vma
+    except Exception:
+        _vma_tracking = False
 
     def _already_reduced(g) -> bool:
         """shard_map autodiff inserts the psum itself when differentiating
@@ -93,6 +104,8 @@ def reduce_gradients(grads,
         so such grads arrive already *summed* over the axis.  They carry an
         empty varying-manual-axes (vma) set; axis-varying grads (per-shard
         values, e.g. under pmap-style code) still need the collective."""
+        if not _vma_tracking:
+            return False
         try:
             vma = jax.typeof(g).vma
         except AttributeError:
@@ -103,8 +116,11 @@ def reduce_gradients(grads,
         if not _is_float(g):
             return g
         if _already_reduced(g):
+            # The implicit psum summed over the FULL axis (subgroup structure
+            # is invisible to shard_map's transpose), so average over the
+            # full axis size regardless of axis_index_groups.
             if gradient_average:
-                return (g / world_size).astype(jnp.asarray(g).dtype)
+                return (g / full_world).astype(jnp.asarray(g).dtype)
             return g
         orig_dtype = jnp.asarray(g).dtype
         if allreduce_always_fp32:

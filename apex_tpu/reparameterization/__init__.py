@@ -1,0 +1,176 @@
+"""apex_tpu.reparameterization — weight reparameterizations over pytrees.
+
+Re-design of reference ``apex/reparameterization/`` (hooks-based module
+mutation, reparameterization.py:4-151, weight_norm.py:22-78).  In JAX,
+parameters are pytrees and the forward is pure, so a reparameterization is a
+**pair of pure functions**:
+
+* ``apply_*(params, ...)``  — split selected weights ``w`` into auxiliary
+  params (e.g. ``{name}_g``/``{name}_v``), returning the new pytree.
+* ``reconstruct(params)``   — rebuild the original weights from the auxiliary
+  params.  Compose with any apply_fn: ``model.apply(reconstruct(p), x)``;
+  the recomputation happens inside the traced step exactly like the
+  reference's pre-forward hook recompute, and autograd flows to g/v.
+
+``remove_*`` folds the reparameterization back into plain weights
+(reference ``remove_reparameterization``, __init__.py:96-123).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Reparameterization", "WeightNorm", "apply_weight_norm",
+           "remove_weight_norm", "apply_reparameterization",
+           "remove_reparameterization", "reconstruct"]
+
+
+def _norm_except_dim(v, dim):
+    """Norm over all dims except ``dim`` (reference weight_norm.py:7-18);
+    ``dim=None`` → scalar full-tensor norm."""
+    v32 = v.astype(jnp.float32)
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v32)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes, keepdims=True))
+
+
+class Reparameterization:
+    """Base: subclasses define ``reparameterize(w) -> aux_dict`` and
+    ``compute_weight(aux_dict) -> w`` (reference reparameterization.py:28-56)."""
+
+    name = "reparam"
+
+    def __init__(self, dim: Optional[int] = 0):
+        self.dim = dim
+
+    def reparameterize(self, weight):
+        raise NotImplementedError
+
+    def compute_weight(self, aux):
+        raise NotImplementedError
+
+
+class WeightNorm(Reparameterization):
+    """w = g * v / ‖v‖ (reference weight_norm.py:22-78).  The fused CUDA
+    kernel (``Fused_Weight_Norm``) dissolves: XLA fuses the norm + scale
+    into the consumer matmul's epilogue."""
+
+    name = "weight_norm"
+
+    def reparameterize(self, weight):
+        return {"g": _norm_except_dim(weight, self.dim).astype(jnp.float32),
+                "v": weight}
+
+    def compute_weight(self, aux):
+        v, g = aux["v"], aux["g"]
+        w = g * v.astype(jnp.float32) / (_norm_except_dim(v, self.dim) + 1e-12)
+        return w.astype(v.dtype)
+
+
+_MARKER = "__reparam__"
+
+
+@jax.tree_util.register_static
+class _Kind:
+    """Static (leafless) pytree marker naming the reparameterization — safe
+    to carry through jit/grad, unlike a raw string leaf."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __eq__(self, other):
+        return isinstance(other, _Kind) and other.name == self.name
+
+    def __hash__(self):
+        return hash(("_Kind", self.name))
+
+    def __repr__(self):
+        return f"_Kind({self.name!r})"
+
+
+def _match(path_str: str, name: str) -> bool:
+    if not name:
+        # default: every kernel/weight leaf (reference name='' applies to all
+        # weight-named params in the module tree, __init__.py:24-43)
+        return bool(re.search(r"(kernel|weight)$", path_str))
+    return name in path_str
+
+
+def apply_reparameterization(params, reparameterization: Reparameterization,
+                             name: str = "", dim: int = 0):
+    """Replace matching weight leaves with ``{_MARKER: cls, aux...}`` subtrees."""
+    rep = reparameterization
+
+    def transform(tree, prefix=""):
+        if isinstance(tree, dict):
+            new = {}
+            for k, v in tree.items():
+                path = f"{prefix}/{k}" if prefix else k
+                if isinstance(v, dict):
+                    new[k] = transform(v, path)
+                elif hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
+                        and v.ndim >= 2 and _match(path, name):
+                    aux = rep.reparameterize(v)
+                    aux[_MARKER] = _Kind(rep.name)
+                    new[k] = aux
+                else:
+                    new[k] = v
+            return new
+        return tree
+
+    return transform(_to_plain_dict(params))
+
+
+def _to_plain_dict(tree):
+    """FrozenDict / dict normalization."""
+    if hasattr(tree, "unfreeze"):
+        tree = tree.unfreeze()
+    if isinstance(tree, dict):
+        return {k: _to_plain_dict(v) for k, v in tree.items()}
+    return tree
+
+
+_REGISTRY = {}
+
+
+def _register(cls):
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+_register(WeightNorm)
+
+
+def reconstruct(params, dim: int = 0):
+    """Rebuild plain weights from reparameterized subtrees — call on the
+    params pytree before (or inside) ``model.apply``; this is the pre-forward
+    recompute hook (reference reparameterization.py:139-146) as a pure fn."""
+    def walk(tree):
+        if isinstance(tree, dict):
+            if _MARKER in tree:
+                rep = _REGISTRY[tree[_MARKER].name](dim=dim)
+                return rep.compute_weight(
+                    {k: v for k, v in tree.items() if k != _MARKER})
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+    return walk(_to_plain_dict(params))
+
+
+def remove_reparameterization(params, dim: int = 0):
+    """Fold aux params back into plain weights (reference __init__.py:96-123)."""
+    return reconstruct(params, dim=dim)
+
+
+def apply_weight_norm(params, name: str = "", dim: int = 0):
+    """Weight-normalize matching weights (reference __init__.py:4-49)."""
+    return apply_reparameterization(params, WeightNorm(dim=dim), name=name,
+                                    dim=dim)
+
+
+def remove_weight_norm(params, name: str = "", dim: int = 0):
+    return remove_reparameterization(params, dim=dim)

@@ -1,0 +1,82 @@
+"""apex_tpu benchmark — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json): ResNet-50 images/sec/chip at amp O2
+(bf16 compute, fp32 masters, fused SGD update) — one fully-jitted train
+step per iteration, synthetic ImageNet-shaped data.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md) and the
+amp-O0 fp32 run on the same chip is the only in-repo baseline, so we report
+the O2/O0 speedup (>1.0 means mixed precision is paying for itself).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _make_step(opt_level, batch, image_size=224, num_classes=1000):
+    from apex_tpu import training
+    from apex_tpu.models import ResNet50
+    from apex_tpu.training import make_train_step
+
+    dtype = jnp.bfloat16 if opt_level in ("O2", "O3") else jnp.float32
+    model = ResNet50(num_classes=num_classes, dtype=dtype)
+    x = jnp.ones((batch, image_size, image_size, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, ms, b):
+        xb, yb = b
+        logits, updated = model.apply(
+            {"params": p, "batch_stats": ms}, xb, train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+        return loss, updated["batch_stats"]
+
+    tx = training.sgd(lr=0.1, momentum=0.9)
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level=opt_level,
+                                       has_model_state=True)
+    state = init_fn(params, batch_stats)
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    return step, state, (x, y)
+
+
+def _time_steps(step, state, batch, warmup=3, iters=20):
+    for _ in range(warmup):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 128 if on_tpu else 8
+    size = 224 if on_tpu else 32
+    iters = 20 if on_tpu else 5
+
+    step2, state2, data2 = _make_step("O2", batch, size)
+    t_o2 = _time_steps(step2, state2, data2, iters=iters)
+    ips_o2 = batch / t_o2
+
+    step0, state0, data0 = _make_step("O0", batch, size)
+    t_o0 = _time_steps(step0, state0, data0, iters=iters)
+
+    print(json.dumps({
+        "metric": "resnet50_amp_o2_images_per_sec_per_chip",
+        "value": round(ips_o2, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(t_o0 / t_o2, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Legacy bf16_utils/fp16_utils surface tests (reference
+tests/L0/run_fp16util/test_fp16util.py pattern: conversion type checks, plus
+FP16_Optimizer step/overflow/checkpoint behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import bf16_utils, fp16_utils
+from apex_tpu.bf16_utils import (
+    BN_convert_float, BF16Model, DynamicLossScaler, FP16_Optimizer,
+    clip_grad_norm, convert_network, master_params_to_model_params,
+    model_grads_to_master_grads, network_to_half, prep_param_lists, to_bf16)
+from apex_tpu.optimizers import FusedSGD
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32),
+                  "bias": jnp.zeros((4,), jnp.float32)},
+        "bn": {"scale": jnp.ones((4,), jnp.float32),
+               "bias": jnp.zeros((4,), jnp.float32)},
+    }
+
+
+def test_fp16_utils_is_alias():
+    assert fp16_utils.FP16_Optimizer is bf16_utils.FP16_Optimizer
+
+
+def test_convert_network_keeps_norm_fp32():
+    conv = convert_network(_params(), jnp.bfloat16)
+    assert conv["dense"]["kernel"].dtype == jnp.bfloat16
+    assert conv["bn"]["scale"].dtype == jnp.float32
+
+
+def test_bn_convert_float_restores_norm():
+    all_bf16 = to_bf16(_params())
+    back = BN_convert_float(all_bf16)
+    assert back["bn"]["scale"].dtype == jnp.float32
+    assert back["dense"]["kernel"].dtype == jnp.bfloat16
+
+
+def test_network_to_half_casts_inputs():
+    def apply_fn(p, x):
+        assert x.dtype == jnp.bfloat16
+        return x @ p["dense"]["kernel"]
+
+    bf16_apply, p = network_to_half(apply_fn, _params())
+    out = bf16_apply(p, jnp.ones((2, 4), jnp.float32))
+    assert out.dtype == jnp.bfloat16
+
+    model = BF16Model(apply_fn, _params())
+    assert model(jnp.ones((2, 4), jnp.float32)).shape == (2, 4)
+
+
+def test_prep_param_lists_flat_roundtrip():
+    params = to_bf16(_params())
+    model_p, master = prep_param_lists(params, flat_master=True)
+    assert master.dtype == jnp.float32
+    assert master.size == sum(x.size for x in jax.tree_util.tree_leaves(params))
+    restored = master_params_to_model_params(model_p, master, flat_master=True)
+    chex_leaves = jax.tree_util.tree_leaves(restored)
+    for a, b in zip(chex_leaves, jax.tree_util.tree_leaves(params)):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32))
+
+
+def test_master_grads_cast():
+    grads = to_bf16({"w": jnp.full((3,), 2.0)})
+    master = model_grads_to_master_grads(grads)
+    assert master["w"].dtype == jnp.float32
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((9,), 4.0)}
+    norm = float(np.sqrt(4 * 9 + 9 * 16))
+    clipped, total = clip_grad_norm(grads, norm / 2)
+    assert abs(float(total) - norm) < 1e-4
+    new_norm = float(jnp.sqrt(sum(jnp.sum(x ** 2)
+                                  for x in jax.tree_util.tree_leaves(clipped))))
+    assert abs(new_norm - norm / 2) < 1e-3
+
+
+def test_dynamic_loss_scaler_state_machine():
+    s = DynamicLossScaler(init_scale=4.0, scale_window=2)
+    assert not s.has_overflow({"g": jnp.ones((2,))})
+    assert s.has_overflow({"g": jnp.asarray([1.0, np.inf])})
+    s.update_scale(True)
+    assert s.loss_scale == 2.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 4.0
+
+
+def test_fp16_optimizer_step_and_overflow_skip():
+    params = to_bf16({"w": jnp.ones((4,), jnp.float32)})
+    opt = FP16_Optimizer(FusedSGD(params, lr=0.5),
+                         dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 4.0})
+    scale = opt.loss_scale
+    # grads of the scaled loss: dL/dw = 1 * scale
+    grads = {"w": jnp.full((4,), 1.0 * scale, jnp.bfloat16)}
+    opt.backward(grads)
+    assert not opt.overflow
+    opt.step()
+    np.testing.assert_allclose(
+        np.asarray(opt.master_params["w"]), 0.5, atol=1e-2)
+    assert opt.model_params["w"].dtype == jnp.bfloat16
+
+    w_before = np.asarray(opt.master_params["w"]).copy()
+    opt.backward({"w": jnp.asarray([np.inf, 1, 1, 1], jnp.bfloat16)})
+    assert opt.overflow
+    opt.step()  # skipped
+    np.testing.assert_array_equal(np.asarray(opt.master_params["w"]), w_before)
+    assert opt.loss_scale == scale / 2
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    params = to_bf16({"w": jnp.ones((4,), jnp.float32)})
+    opt = FP16_Optimizer(FusedSGD(params, lr=0.1, momentum=0.9),
+                         dynamic_loss_scale=True)
+    g = {"w": jnp.full((4,), opt.loss_scale, jnp.bfloat16)}
+    opt.backward(g)
+    opt.step()
+    sd = opt.state_dict()
+
+    opt2 = FP16_Optimizer(FusedSGD(to_bf16({"w": jnp.zeros((4,))}),
+                                   lr=0.1, momentum=0.9),
+                          dynamic_loss_scale=True)
+    opt2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(opt2.master_params["w"]),
+                               np.asarray(opt.master_params["w"]))
+    assert opt2.loss_scaler.cur_iter == opt.loss_scaler.cur_iter

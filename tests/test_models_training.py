@@ -29,7 +29,7 @@ def test_resnet_forward_shapes():
     assert out.dtype == jnp.float32
 
 
-@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+@pytest.mark.parametrize("opt_level", ["O0", "O2", "O3"])
 def test_resnet_train_step_loss_decreases(opt_level):
     model = ResNet18(num_classes=10, dtype=jnp.bfloat16
                      if opt_level in ("O2", "O3") else jnp.float32)

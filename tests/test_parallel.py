@@ -49,6 +49,45 @@ def test_reduce_gradients_mean():
                                rtol=1e-6)
 
 
+def test_reduce_gradients_check_vma_false_still_reduces():
+    """Regression: under shard_map(check_vma=False) every aval has an empty
+    vma set — that must NOT be mistaken for 'already psummed' (there the
+    implicit-broadcast transpose does not insert the psum either)."""
+    mesh = _mesh()
+    grads = jnp.arange(NDEV, dtype=jnp.float32)
+    f = shard_map(lambda g: reduce_gradients({"w": g}, "data")["w"],
+                  mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                  check_vma=False)
+    out = np.asarray(f(grads))
+    np.testing.assert_allclose(out, np.full(NDEV, np.asarray(grads).mean()),
+                               rtol=1e-6)
+
+
+def test_reduce_gradients_implicit_psum_with_subgroups_divides_full_axis():
+    """Regression: a grad already full-axis-psummed by shard_map autodiff
+    must be divided by the FULL axis size even when axis_index_groups names
+    subgroups (the implicit psum ignores group structure)."""
+    mesh = _mesh()
+    groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    x = jnp.asarray(np.random.RandomState(0).randn(NDEV * 2, 3), jnp.float32)
+    w = jnp.ones((3,), jnp.float32)
+
+    def step(w_rep, xs):
+        def loss(wl):
+            return jnp.mean((xs @ wl) ** 2)
+        g = jax.grad(loss)(w_rep)     # implicit full-axis psum (replicated w)
+        return reduce_gradients({"w": g}, "data",
+                                axis_index_groups=groups)["w"]
+
+    f = shard_map(step, mesh=mesh, in_specs=(P(), P("data")), out_specs=P())
+    got = np.asarray(jax.jit(f)(w, x))
+    # Oracle: average over ALL replicas of the per-shard grad.
+    want = np.asarray(jax.grad(
+        lambda wl: jnp.mean(jnp.stack([jnp.mean((xs @ wl) ** 2)
+                                       for xs in jnp.split(x, NDEV)])))(w))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
 def test_reduce_gradients_sum_when_average_off():
     mesh = _mesh()
     grads = jnp.ones((NDEV, 4), jnp.float32)

@@ -1,0 +1,120 @@
+"""apex_tpu.RNN + reparameterization tests (reference test model:
+tests/L0/run_amp/test_rnn.py exercises cells/stacks; weight-norm math vs
+the v·g/‖v‖ definition, reference weight_norm.py:22-78)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.RNN import LSTM, GRU, ReLU, Tanh, mLSTM
+from apex_tpu.reparameterization import (apply_weight_norm, reconstruct,
+                                         remove_weight_norm)
+
+T, B, F, H = 5, 3, 4, 8
+
+
+@pytest.mark.parametrize("factory,n_states", [
+    (LSTM, 2), (GRU, 1), (ReLU, 1), (Tanh, 1), (mLSTM, 2)])
+def test_rnn_shapes_and_states(factory, n_states):
+    model = factory(F, H, num_layers=2)
+    x = jnp.asarray(np.random.RandomState(0).randn(T, B, F), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    (out, finals), = [model.apply(params, x)]
+    assert out.shape == (T, B, H)
+    assert len(finals) == 2            # per layer
+    assert len(finals[0]) == n_states  # (h,) or (h, c)
+    assert finals[0][0].shape == (B, H)
+
+
+def test_rnn_batch_first_and_proj():
+    model = LSTM(F, H, num_layers=1, batch_first=True, output_size=6)
+    x = jnp.ones((B, T, F))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out, _ = model.apply(params, x)
+    assert out.shape == (B, T, 6)
+
+
+def test_bidirectional_concat():
+    model = GRU(F, H, num_layers=1, bidirectional=True)
+    x = jnp.ones((T, B, F))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out, (fin_f, fin_r) = model.apply(params, x)
+    assert out.shape == (T, B, 2 * H)
+    assert len(fin_f) == 1 and len(fin_r) == 1
+
+
+def test_rnn_initial_state_threading():
+    """Final state of one chunk feeds the next — the functional version of
+    the reference's persistent hidden state (RNNBackend.py:309-347)."""
+    model = Tanh(F, H, num_layers=1)
+    x = jnp.asarray(np.random.RandomState(1).randn(2 * T, B, F), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    full, _ = model.apply(params, x)
+    out1, fin1 = model.apply(params, x[:T])
+    out2, _ = model.apply(params, x[T:], initial_states=fin1)
+    np.testing.assert_allclose(np.asarray(full[T:]), np.asarray(out2),
+                               atol=1e-5)
+
+
+def test_rnn_grads_flow():
+    model = LSTM(F, H, num_layers=1)
+    x = jnp.ones((T, B, F))
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(p):
+        out, _ = model.apply(p, x)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    gsum = sum(float(jnp.sum(jnp.abs(g)))
+               for g in jax.tree_util.tree_leaves(grads))
+    assert np.isfinite(gsum) and gsum > 0
+
+
+def test_weight_norm_roundtrip():
+    params = {"dense": {"kernel": jnp.asarray(
+        np.random.RandomState(0).randn(4, 6), jnp.float32),
+        "bias": jnp.zeros((6,))}}
+    wn = apply_weight_norm(params)
+    assert "g" in wn["dense"]["kernel"] and "v" in wn["dense"]["kernel"]
+    # g has one magnitude per dim-0 slice
+    assert wn["dense"]["kernel"]["g"].shape == (4, 1)
+    rebuilt = reconstruct(wn)
+    np.testing.assert_allclose(np.asarray(rebuilt["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]),
+                               atol=1e-5)
+    removed = remove_weight_norm(wn)
+    np.testing.assert_allclose(np.asarray(removed["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]),
+                               atol=1e-5)
+
+
+def test_weight_norm_grad_decoupling():
+    """Scaling g scales w; v's direction is what matters — the definitional
+    property w = g·v/‖v‖."""
+    v = jnp.asarray(np.random.RandomState(0).randn(3, 5), jnp.float32)
+    params = {"layer": {"kernel": v}}
+    wn = apply_weight_norm(params)
+    wn2 = jax.tree_util.tree_map(lambda x: x, wn)
+    wn2["layer"]["kernel"] = dict(wn["layer"]["kernel"])
+    wn2["layer"]["kernel"]["v"] = wn["layer"]["kernel"]["v"] * 7.0
+    r1 = reconstruct(wn)
+    r2 = reconstruct(wn2)
+    np.testing.assert_allclose(np.asarray(r1["layer"]["kernel"]),
+                               np.asarray(r2["layer"]["kernel"]), atol=1e-4)
+
+
+def test_weight_norm_inside_jit_and_grad():
+    params = {"dense": {"kernel": jnp.ones((4, 2)), "bias": jnp.zeros((2,))}}
+    wn = apply_weight_norm(params)
+    x = jnp.ones((3, 4))
+
+    @jax.jit
+    def loss(p):
+        w = reconstruct(p)
+        return jnp.sum((x @ w["dense"]["kernel"] + w["dense"]["bias"]) ** 2)
+
+    g = jax.grad(loss)(wn)
+    assert g["dense"]["kernel"]["g"].shape == (4, 1)
+    assert np.isfinite(float(jnp.sum(g["dense"]["kernel"]["v"])))
