@@ -46,7 +46,10 @@ class stackedRNN(nn.Module):
                  collect_hidden: bool = False):
         """``inputs`` [T,B,F] (or [B,T,F] if batch_first).  Returns
         ``(outputs, final_states)`` — outputs [T,B,H], final_states a list
-        of per-layer carries (hy[, cy])."""
+        of per-layer carries (hy[, cy]).  With ``collect_hidden=True``,
+        final_states instead holds every timestep's states per layer
+        (each leaf [T,B,H] — reference ``stackedRNN.forward``
+        RNNBackend.py:122-196 collect_hidden semantics)."""
         if self.batch_first:
             inputs = jnp.swapaxes(inputs, 0, 1)
         if reverse:
@@ -56,8 +59,14 @@ class stackedRNN(nn.Module):
             initial_states = [self._zero_carry(bsz)
                               for _ in range(self.num_layers)]
 
+        def body(cell, carry, x):
+            new_carry, out = cell(carry, x)
+            # Per-step carries are scanned out only when collecting; the
+            # flag is static so the unused path traces away.
+            return new_carry, (out, new_carry if collect_hidden else None)
+
         scan = nn.scan(
-            lambda cell, carry, x: cell(carry, x),
+            body,
             variable_broadcast="params",
             split_rngs={"params": False},
             in_axes=0, out_axes=0)
@@ -68,8 +77,9 @@ class stackedRNN(nn.Module):
             cell = self.cell_cls(hidden_size=self.hidden_size,
                                  bias=self.bias, dtype=self.dtype,
                                  name=f"layer{layer}")
-            carry, x = scan(cell, tuple(initial_states[layer]), x)
-            finals.append(carry)
+            carry, (x, all_states) = scan(
+                cell, tuple(initial_states[layer]), x)
+            finals.append(all_states if collect_hidden else carry)
             if self.dropout > 0 and train and layer < self.num_layers - 1:
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
 

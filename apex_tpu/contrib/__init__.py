@@ -1,0 +1,11 @@
+"""apex_tpu.contrib — opt-in extensions (reference ``apex/contrib/``).
+
+* ``xentropy`` — fused label-smoothing softmax-cross-entropy
+  (reference ``apex/contrib/xentropy`` + ``csrc/xentropy``).
+* ``groupbn`` — NHWC BatchNorm with cross-replica bn_group sync
+  (reference ``apex/contrib/groupbn`` — CUDA-IPC peer exchange there,
+  sub-mesh XLA collectives here).
+"""
+
+from . import xentropy   # noqa: F401
+from . import groupbn    # noqa: F401

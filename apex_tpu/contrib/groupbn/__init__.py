@@ -1,0 +1,73 @@
+"""GroupBN — NHWC BatchNorm with cross-replica bn_group sync.
+
+Re-design of reference ``apex/contrib/groupbn`` (``batch_norm.py:101+``,
+``csrc/groupbn/*``).  The reference builds this from ~5,600 lines of CUDA:
+persistent NHWC kernels + raw CUDA-IPC peer buffers so ``bn_group`` ranks can
+exchange statistics without NCCL.  On TPU:
+
+* NHWC is the native layout — "channels-last" is the default everywhere.
+* bn_group peer exchange = sub-mesh collectives (``axis_index_groups`` on the
+  stats psum) — no IPC analog needed, ICI handles it.
+* the semi-fused bn/bn-add-relu epilogues = ``fuse_relu``/``z`` on our
+  SyncBatchNorm, which XLA fuses into neighbors.
+
+So the whole contrib module reduces to a thin wrapper with the reference's
+constructor surface over :class:`apex_tpu.parallel.SyncBatchNorm`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """Reference ctor: ``BatchNorm2d_NHWC(planes, fuse_relu=False,
+    bn_group=1)`` (``contrib/groupbn/batch_norm.py:101+``).  ``bn_group``
+    is the number of replicas that share statistics; groups are contiguous
+    rank blocks like ``create_syncbn_process_group``
+    (``apex/parallel/__init__.py:55-96``)."""
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    eps: float = 1e-5
+    momentum: float = 0.1
+    axis_name: Optional[str] = None
+    world_size: Optional[int] = None
+    use_running_average: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average=None):
+        process_group = None
+        axis_name = self.axis_name
+        if self.bn_group > 1:
+            if self.world_size is None:
+                raise ValueError("bn_group > 1 requires world_size")
+            if axis_name is None:
+                raise ValueError(
+                    "bn_group > 1 requires axis_name (the mesh axis the "
+                    "replicas live on); without it statistics would stay "
+                    "per-replica")
+            n = self.world_size
+            g = self.bn_group
+            if n % g != 0:
+                raise ValueError(
+                    f"world_size {n} not divisible by bn_group {g}")
+            process_group = [list(range(i, i + g)) for i in range(0, n, g)]
+        elif self.bn_group == 1:
+            # group size 1 == no cross-replica sync
+            axis_name = None
+        bn = SyncBatchNorm(
+            num_features=self.num_features, eps=self.eps,
+            momentum=self.momentum, axis_name=axis_name,
+            process_group=process_group, channel_last=True,
+            fuse_relu=self.fuse_relu,
+            use_running_average=self.use_running_average,
+            name="bn")
+        return bn(x, z=z, use_running_average=use_running_average)

@@ -1,0 +1,185 @@
+"""Fused label-smoothing softmax-cross-entropy.
+
+TPU-native re-design of reference ``apex/contrib/xentropy/softmax_xentropy.py``
++ ``apex/contrib/csrc/xentropy/xentropy_kernel.cu``:
+
+* forward returns per-example ``losses`` and saves only ``max_log_sum_exp``
+  (one fp32 scalar per row) instead of materialized log-probs — the memory
+  trick of the CUDA kernel (interface returns ``(losses, max_log_sum_exp)``).
+* backward is fused: ``d logits = g * (softmax - (1-s)·onehot - s/H)``,
+  recomputed from logits + mlse.
+* positions where ``labels == padding_idx`` contribute zero loss and zero
+  gradient (reference ``softmax_xentropy.py:9,23``).
+
+Loss definition (reference test oracle ``test_label_smoothing.py:10-28``)::
+
+    loss = (1-smoothing) * nll + smoothing * smooth_loss
+    nll = logsumexp(x) - x[label];  smooth_loss = logsumexp(x) - mean(x)
+
+On TPU a Pallas kernel processes a block of rows per grid step (row max /
+sum-exp on the VPU, label extraction via iota-select); off TPU the same math
+runs as jnp, doubling as the oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ...normalization.fused_layer_norm import _use_pallas
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+# -- reference math (jnp fallback + oracle) -----------------------------------
+
+def _fwd_ref(logits, labels, smoothing):
+    xf = logits.astype(jnp.float32)
+    h = xf.shape[-1]
+    m = jnp.max(xf, axis=-1)
+    mlse = m + jnp.log(jnp.sum(jnp.exp(xf - m[:, None]), axis=-1))
+    label_logit = jnp.take_along_axis(xf, labels[:, None], axis=-1)[:, 0]
+    mean_logit = jnp.mean(xf, axis=-1)
+    losses = mlse - (1.0 - smoothing) * label_logit - smoothing * mean_logit
+    return losses, mlse
+
+
+def _bwd_ref(g, logits, mlse, labels, smoothing):
+    xf = logits.astype(jnp.float32)
+    h = xf.shape[-1]
+    soft = jnp.exp(xf - mlse[:, None])
+    onehot = jax.nn.one_hot(labels, h, dtype=jnp.float32)
+    dx = g[:, None] * (soft - (1.0 - smoothing) * onehot - smoothing / h)
+    return dx.astype(logits.dtype)
+
+
+# -- pallas kernels -----------------------------------------------------------
+
+_ROW_BLOCK = 128
+
+
+# Per-row vectors (labels, losses, mlse, incoming grads) travel as [R, 1]
+# 2-D arrays: Mosaic requires lane-tiled ≥2-D layouts; 1-D s32 operands hit
+# an XLA/Mosaic layout mismatch on real TPUs.
+
+def _fwd_kernel(x_ref, lab_ref, loss_ref, mlse_ref, *, smoothing):
+    xf = x_ref[:].astype(jnp.float32)                   # [R, H]
+    h = xf.shape[1]
+    m = jnp.max(xf, axis=1, keepdims=True)
+    mlse = m + jnp.log(jnp.sum(jnp.exp(xf - m), axis=1, keepdims=True))
+    lab = lab_ref[:]                                    # [R, 1]
+    col = jax.lax.broadcasted_iota(jnp.int32, xf.shape, 1)
+    picked = jnp.sum(jnp.where(col == lab, xf, 0.0), axis=1, keepdims=True)
+    mean_logit = jnp.sum(xf, axis=1, keepdims=True) / h
+    loss_ref[:] = (mlse - (1.0 - smoothing) * picked
+                   - smoothing * mean_logit)
+    mlse_ref[:] = mlse
+
+
+def _bwd_kernel(g_ref, x_ref, mlse_ref, lab_ref, dx_ref, *, smoothing):
+    xf = x_ref[:].astype(jnp.float32)
+    h = xf.shape[1]
+    mlse = mlse_ref[:]                                  # [R, 1]
+    g = g_ref[:]                                        # [R, 1]
+    lab = lab_ref[:]                                    # [R, 1]
+    soft = jnp.exp(xf - mlse)
+    col = jax.lax.broadcasted_iota(jnp.int32, xf.shape, 1)
+    onehot = (col == lab).astype(jnp.float32)
+    dx = g * (soft - (1.0 - smoothing) * onehot - smoothing / h)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _fwd_pallas(logits, labels, smoothing):
+    n, h = logits.shape
+    blk = min(_ROW_BLOCK, n)
+    grid = (n + blk - 1) // blk
+    loss, mlse = pl.pallas_call(
+        functools.partial(_fwd_kernel, smoothing=smoothing),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((blk, h), lambda i: (i, 0)),
+                  pl.BlockSpec((blk, 1), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((blk, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+    )(logits, labels[:, None])
+    return loss[:, 0], mlse[:, 0]
+
+
+def _bwd_pallas(g, logits, mlse, labels, smoothing):
+    n, h = logits.shape
+    blk = min(_ROW_BLOCK, n)
+    grid = (n + blk - 1) // blk
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, smoothing=smoothing),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((blk, h), lambda i: (i, 0)),
+                  pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((blk, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((blk, h), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h), logits.dtype),
+    )(g[:, None], logits, mlse[:, None], labels[:, None])
+
+
+# -- public op with custom VJP ------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
+                               half_to_float=False):
+    """Per-example label-smoothing cross entropy, padding masked to zero.
+
+    ``half_to_float`` kept for reference signature parity (bf16 losses are
+    always computed and returned in fp32 here, like the CUDA kernel's
+    fp32 accumulation).
+    """
+    losses, _ = _fwd_impl(logits, labels, smoothing)
+    return jnp.where(labels == padding_idx, 0.0, losses)
+
+
+def _fwd_impl(logits, labels, smoothing):
+    labels = labels.astype(jnp.int32)
+    if _use_pallas():
+        return _fwd_pallas(logits, labels, smoothing)
+    return _fwd_ref(logits, labels, smoothing)
+
+
+def _fwd_vjp(logits, labels, smoothing, padding_idx, half_to_float):
+    labels = labels.astype(jnp.int32)
+    losses, mlse = _fwd_impl(logits, labels, smoothing)
+    losses = jnp.where(labels == padding_idx, 0.0, losses)
+    return losses, (logits, mlse, labels)
+
+
+def _bwd_vjp(smoothing, padding_idx, half_to_float, res, g):
+    logits, mlse, labels = res
+    g = jnp.where(labels == padding_idx, 0.0,
+                  g.astype(jnp.float32))
+    if _use_pallas():
+        dx = _bwd_pallas(g, logits, mlse, labels, smoothing)
+    else:
+        dx = _bwd_ref(g, logits, mlse, labels, smoothing)
+    return dx, None
+
+
+softmax_cross_entropy_loss.defvjp(_fwd_vjp, _bwd_vjp)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Reference-compatible callable (``softmax_xentropy.py:4-28`` exposes
+    ``SoftmaxCrossEntropyLoss.apply(...)``)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        return softmax_cross_entropy_loss(logits, labels, smoothing,
+                                          padding_idx, half_to_float)
+
+    def __call__(self, logits, labels, smoothing=0.0, padding_idx=0,
+                 half_to_float=False):
+        return self.apply(logits, labels, smoothing, padding_idx,
+                          half_to_float)

@@ -77,20 +77,23 @@ _MARKER = "__reparam__"
 
 @jax.tree_util.register_static
 class _Kind:
-    """Static (leafless) pytree marker naming the reparameterization — safe
-    to carry through jit/grad, unlike a raw string leaf."""
+    """Static (leafless) pytree marker recording the reparameterization name
+    and its ``dim`` — safe to carry through jit/grad, unlike a raw string
+    leaf, and self-describing so ``reconstruct`` needs no side channel."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, dim=0):
         self.name = name
+        self.dim = dim
 
     def __eq__(self, other):
-        return isinstance(other, _Kind) and other.name == self.name
+        return (isinstance(other, _Kind) and other.name == self.name
+                and other.dim == self.dim)
 
     def __hash__(self):
-        return hash(("_Kind", self.name))
+        return hash(("_Kind", self.name, self.dim))
 
     def __repr__(self):
-        return f"_Kind({self.name!r})"
+        return f"_Kind({self.name!r}, dim={self.dim})"
 
 
 def _match(path_str: str, name: str) -> bool:
@@ -116,7 +119,7 @@ def apply_reparameterization(params, reparameterization: Reparameterization,
                 elif hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
                         and v.ndim >= 2 and _match(path, name):
                     aux = rep.reparameterize(v)
-                    aux[_MARKER] = _Kind(rep.name)
+                    aux[_MARKER] = _Kind(rep.name, rep.dim)
                     new[k] = aux
                 else:
                     new[k] = v
@@ -146,24 +149,32 @@ def _register(cls):
 _register(WeightNorm)
 
 
-def reconstruct(params, dim: int = 0):
+def reconstruct(params, name: str = ""):
     """Rebuild plain weights from reparameterized subtrees — call on the
     params pytree before (or inside) ``model.apply``; this is the pre-forward
-    recompute hook (reference reparameterization.py:139-146) as a pure fn."""
-    def walk(tree):
+    recompute hook (reference reparameterization.py:139-146) as a pure fn.
+    The kind and dim come from each subtree's marker (recorded at apply
+    time), so no side-channel arguments are needed; ``name`` restricts the
+    fold-back to matching paths (reference per-name removal)."""
+    def walk(tree, prefix=""):
         if isinstance(tree, dict):
             if _MARKER in tree:
-                rep = _REGISTRY[tree[_MARKER].name](dim=dim)
+                if name and name not in prefix:
+                    return tree
+                kind = tree[_MARKER]
+                rep = _REGISTRY[kind.name](dim=kind.dim)
                 return rep.compute_weight(
                     {k: v for k, v in tree.items() if k != _MARKER})
-            return {k: walk(v) for k, v in tree.items()}
+            return {k: walk(v, f"{prefix}/{k}" if prefix else k)
+                    for k, v in tree.items()}
         return tree
     return walk(_to_plain_dict(params))
 
 
-def remove_reparameterization(params, dim: int = 0):
-    """Fold aux params back into plain weights (reference __init__.py:96-123)."""
-    return reconstruct(params, dim=dim)
+def remove_reparameterization(params, name: str = ""):
+    """Fold aux params back into plain weights (reference __init__.py:96-123);
+    ``name`` limits removal to matching paths."""
+    return reconstruct(params, name=name)
 
 
 def apply_weight_norm(params, name: str = "", dim: int = 0):
@@ -173,4 +184,5 @@ def apply_weight_norm(params, name: str = "", dim: int = 0):
 
 
 def remove_weight_norm(params, name: str = "", dim: int = 0):
-    return remove_reparameterization(params, dim=dim)
+    del dim  # recorded in each marker at apply time
+    return remove_reparameterization(params, name=name)

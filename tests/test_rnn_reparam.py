@@ -57,6 +57,40 @@ def test_rnn_initial_state_threading():
                                atol=1e-5)
 
 
+def test_rnn_collect_hidden():
+    """collect_hidden=True returns every timestep's states per layer."""
+    model = LSTM(F, H, num_layers=2)
+    x = jnp.ones((T, B, F))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out, per_step = model.apply(params, x, collect_hidden=True)
+    assert len(per_step) == 2
+    h_all, c_all = per_step[0]
+    assert h_all.shape == (T, B, H) and c_all.shape == (T, B, H)
+    # Last collected state equals the final state from the default call.
+    _, finals = model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(h_all[-1]),
+                               np.asarray(finals[0][0]), atol=1e-6)
+
+
+def test_weight_norm_dim_recorded_in_marker():
+    """Regression: reconstruct must use the dim recorded at apply time."""
+    v = jnp.asarray(np.random.RandomState(0).randn(3, 5), jnp.float32)
+    wn = apply_weight_norm({"l": {"kernel": v}}, dim=1)
+    assert wn["l"]["kernel"]["g"].shape == (1, 5)
+    rebuilt = reconstruct(wn)          # no dim argument — comes from marker
+    np.testing.assert_allclose(np.asarray(rebuilt["l"]["kernel"]),
+                               np.asarray(v), atol=1e-5)
+
+
+def test_remove_weight_norm_respects_name_filter():
+    params = {"a": {"kernel": jnp.ones((2, 3))},
+              "b": {"kernel": jnp.ones((2, 3))}}
+    wn = apply_weight_norm(params)
+    partial = remove_weight_norm(wn, name="a")
+    assert hasattr(partial["a"]["kernel"], "dtype")   # folded back to array
+    assert isinstance(partial["b"]["kernel"], dict)   # still reparameterized
+
+
 def test_rnn_grads_flow():
     model = LSTM(F, H, num_layers=1)
     x = jnp.ones((T, B, F))
