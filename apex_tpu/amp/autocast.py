@@ -65,11 +65,16 @@ def cached_cast(dtype, x):
     if jnp.asarray(x).dtype == jnp.dtype(dtype):
         return x
     key = (id(x), jnp.dtype(dtype).name)
-    if key in _cast_cache:
-        return _cast_cache[key]
+    hit = _cast_cache.get(key)
+    if hit is not None and hit[0] is x:
+        return hit[1]
     out = jnp.asarray(x).astype(dtype)
     if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
-        _cast_cache[key] = out
+        # Store the SOURCE alongside the cast: the key is id(x), and ids are
+        # reused once an array is collected — without pinning x, a later
+        # array at the same address would silently receive this stale cast
+        # (observed as shape corruption in the DCGAN multi-model loop).
+        _cast_cache[key] = (x, out)
     return out
 
 

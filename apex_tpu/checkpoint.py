@@ -1,0 +1,112 @@
+"""Checkpoint / resume for train states and amp state.
+
+Reference recipe (SURVEY.md §5, README "Checkpointing"): save model /
+optimizer / amp dicts, restore *after* ``amp.initialize`` with the same
+opt_level; resumed training is bitwise identical
+(``tests/L0/run_amp/test_checkpointing.py:73-199``).
+
+TPU-native form: any pytree (e.g. ``training.TrainState`` — params, opt
+state, scaler state, batch stats) serializes to one ``.npz`` via the native
+flatten path; structure is recorded as key paths so the checkpoint is
+readable without the original treedef.  O2 keeps fp32 masters as the stored
+source of truth, so checkpoints are precision-portable by construction (the
+reference needs an ``O2StateDictHook`` to fake this —
+``_initialize.py:129-138``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+_DTYPE_TAG = "@dtype="
+
+
+def _encode(arr: np.ndarray):
+    """npz cannot store ml_dtypes (bfloat16 → '|V2'); store such arrays as
+    raw uint bits with the true dtype recorded in the key."""
+    if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
+        bits = {1: np.uint8, 2: np.uint16, 4: np.uint32}[arr.dtype.itemsize]
+        return arr.view(bits), arr.dtype.name
+    return arr, None
+
+
+def _decode(arr: np.ndarray, dtype_name):
+    if dtype_name is None:
+        return arr
+    import ml_dtypes  # ships with jax
+    return arr.view(np.dtype(getattr(ml_dtypes, dtype_name, dtype_name)))
+
+
+def _flatten_with_paths(tree):
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        arr, tag = _encode(np.asarray(jax.device_get(leaf)))
+        if tag is not None:
+            key = key + _DTYPE_TAG + tag
+        out[key] = arr
+    return out
+
+
+def save_checkpoint(path: str, state, amp_state: Optional[dict] = None,
+                    **extra) -> None:
+    """Serialize ``state`` (any pytree) + optional amp ``state_dict`` to
+    ``path`` (.npz)."""
+    arrays = _flatten_with_paths(state)
+    if amp_state:
+        for k, v in _flatten_with_paths(amp_state).items():
+            arrays["__amp__/" + k] = v
+    for k, v in extra.items():
+        arrays["__extra__/" + k] = np.asarray(v)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)         # atomic publish
+
+
+def load_checkpoint(path: str, like):
+    """Restore a pytree shaped like ``like`` from ``path``; returns
+    ``(state, amp_state_dict, extra_dict)``.  Dtypes/shapes must match the
+    template (same opt_level rule as the reference recipe)."""
+    with np.load(path, allow_pickle=False) as data:
+        arrays = {k: data[k] for k in data.files}
+    amp_state = {}
+    extra = {}
+    plain = {}
+    for k, v in arrays.items():
+        if _DTYPE_TAG in k:
+            k, tag = k.split(_DTYPE_TAG, 1)
+            v = _decode(v, tag)
+        if k.startswith("__amp__/"):
+            amp_state[k[len("__amp__/"):]] = v
+        elif k.startswith("__extra__/"):
+            extra[k[len("__extra__/"):]] = v
+        else:
+            plain[k] = v
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for path_elems, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path_elems)
+        if key not in plain:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = plain[key]
+        want_dtype = np.asarray(jax.device_get(leaf)).dtype \
+            if hasattr(leaf, "dtype") else None
+        if want_dtype is not None and arr.dtype != want_dtype:
+            raise ValueError(
+                f"dtype mismatch for {key!r}: checkpoint {arr.dtype}, "
+                f"template {want_dtype} — restore with the same opt_level "
+                f"used at save time (reference checkpointing rule)")
+        leaves.append(jax.numpy.asarray(arr))
+    state = jax.tree_util.tree_unflatten(
+        treedef, leaves)
+    return state, amp_state, extra

@@ -1,0 +1,157 @@
+"""Input pipeline: threaded host-side prefetch + native decode epilogue.
+
+The reference's imagenet example leans on NVIDIA DALI / pinned-memory
+``data_prefetcher`` (examples/imagenet/main_amp.py:262-310: CUDA-stream
+prefetch overlapping H2D copies with compute).  The TPU-native equivalent:
+
+* a background thread pool runs the batch producer (disk/decode/augment —
+  the normalize epilogue in native C++, :func:`apex_tpu.native.
+  u8_to_f32_nhwc`);
+* finished host batches are ``jax.device_put`` eagerly so the H2D DMA
+  overlaps the running step (the ``record_stream`` trick is XLA's job);
+* a bounded queue applies back-pressure.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from . import native
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def normalize_images(u8_batch: np.ndarray,
+                     mean: Sequence[float] = IMAGENET_MEAN,
+                     std: Sequence[float] = IMAGENET_STD) -> np.ndarray:
+    """uint8 NHWC -> normalized float32 NHWC via the native runtime."""
+    return native.u8_to_f32_nhwc(u8_batch, mean, std)
+
+
+class PrefetchLoader:
+    """Wrap any iterable of host batches with N-deep device prefetch
+    (the ``data_prefetcher`` analog)."""
+
+    def __init__(self, it, depth: int = 2,
+                 transform: Optional[Callable] = None,
+                 device=None):
+        self._it = it
+        self._depth = depth
+        self._transform = transform
+        self._device = device
+
+    def __iter__(self) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        _SENTINEL = object()
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # Bounded put that gives up when the consumer is gone, so an
+            # abandoned iterator can't pin the thread + device batches.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self._it:
+                    if stop.is_set():
+                        return
+                    if self._transform is not None:
+                        batch = self._transform(batch)
+                    batch = jax.tree_util.tree_map(
+                        lambda x: jax.device_put(x, self._device)
+                        if hasattr(x, "shape") else x, batch)
+                    if not _put(batch):
+                        return
+            except BaseException as e:   # surface producer errors
+                _put(("__error__", e))
+            finally:
+                _put(_SENTINEL)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == "__error__":
+                    raise item[1]
+                yield item
+        finally:
+            # GeneratorExit (break / del) lands here: release the producer.
+            stop.set()
+            while True:               # drain so the thread's put unblocks
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
+                       shuffle: bool = True, seed: int = 0):
+    """Stream (uint8 NHWC batch, labels) from an ImageNet-style directory:
+    ``root/<class_name>/*.{npy,jpg,jpeg,png}``.  ``.npy`` files must hold
+    HWC uint8; image files decode via PIL when available.  The heavy
+    epilogue (normalize) stays in :func:`normalize_images` (native C++)."""
+    import os
+
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise ValueError(f"no class subdirectories under {root}")
+    class_idx = {c: i for i, c in enumerate(classes)}
+    samples = []
+    for c in classes:
+        cdir = os.path.join(root, c)
+        for f in os.listdir(cdir):
+            if f.lower().endswith((".npy", ".jpg", ".jpeg", ".png")):
+                samples.append((os.path.join(cdir, f), class_idx[c]))
+    if not samples:
+        raise ValueError(f"no samples under {root}")
+    rng = np.random.RandomState(seed)
+    if shuffle:
+        rng.shuffle(samples)
+
+    def load(path):
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            from PIL import Image   # optional dep; gate at use time
+            img = np.asarray(Image.open(path).convert("RGB"))
+        if img.shape[:2] != (image_size, image_size):
+            # nearest-neighbor resize without extra deps
+            ys = (np.linspace(0, img.shape[0] - 1, image_size)).astype(int)
+            xs = (np.linspace(0, img.shape[1] - 1, image_size)).astype(int)
+            img = img[ys][:, xs]
+        return img.astype(np.uint8)
+
+    for i in range(0, len(samples) - batch_size + 1, batch_size):
+        batch = samples[i:i + batch_size]
+        imgs = np.stack([load(p) for p, _ in batch])
+        labels = np.asarray([l for _, l in batch], np.int32)
+        yield imgs, labels
+
+
+def synthetic_imagenet(batch_size: int, image_size: int = 224,
+                       num_classes: int = 1000, steps: int = 100,
+                       seed: int = 0):
+    """Synthetic uint8 image stream (benchmarks / tests)."""
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        imgs = rng.randint(0, 256, (batch_size, image_size, image_size, 3),
+                           dtype=np.uint8)
+        labels = rng.randint(0, num_classes, (batch_size,))
+        yield imgs, labels

@@ -19,8 +19,21 @@ from ..normalization import FusedLayerNorm
 
 
 class BertSelfAttention(nn.Module):
+    """Self-attention with a pluggable compute strategy.
+
+    ``attention_impl``: ``"full"`` (materialized scores, the oracle),
+    ``"blockwise"`` (flash-style online softmax, O(T) memory), ``"ring"``
+    (ring attention over sequence shards — call inside shard_map with the
+    sequence split over ``sp_axis``), or ``"ulysses"`` (all-to-all head
+    resharding).  Ring/Ulysses are the long-context paths; they take the
+    padding mask only via causal=False full-visibility (use blockwise bias
+    for padding within a shard-local setting).
+    """
     num_heads: int
     dtype: Any = jnp.float32
+    attention_impl: str = "full"
+    sp_axis: Optional[str] = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -32,14 +45,32 @@ class BertSelfAttention(nn.Module):
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
-        # bf16 QK^T on the MXU, fp32 softmax (the cast-list split).
-        scores = jnp.einsum("...qhd,...khd->...hqk", q, k) / jnp.sqrt(
-            jnp.float32(head_dim)).astype(x.dtype)
-        scores = scores.astype(jnp.float32)
-        if mask is not None:
-            scores = jnp.where(mask[:, None, None, :], scores, -1e9)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        ctx = jnp.einsum("...hqk,...khd->...qhd", probs, v)
+        if self.attention_impl in ("ring", "ulysses"):
+            if mask is not None:
+                raise ValueError(
+                    "ring/ulysses attention paths take no padding mask; pad "
+                    "to shard boundaries or use attention_impl='blockwise'")
+            from ..parallel.ring_attention import (ring_attention,
+                                                   ulysses_attention)
+            fn = (ring_attention if self.attention_impl == "ring"
+                  else ulysses_attention)
+            ctx = fn(q, k, v, self.sp_axis, causal=self.causal)
+        elif self.attention_impl == "blockwise":
+            from ..ops.attention import blockwise_attention
+            bias = None
+            if mask is not None:
+                bias = jnp.where(mask[:, None, None, :], 0.0, -1e9)
+            ctx = blockwise_attention(q, k, v, causal=self.causal, bias=bias)
+        else:
+            # The numerics oracle in ops.attention (bf16 QK^T on the MXU,
+            # fp32 softmax — the cast-list split lives there).
+            from ..ops.attention import dot_product_attention
+            bias = None
+            if mask is not None:
+                bias = jnp.where(mask[:, None, None, :], 0.0, -1e9)
+            ctx = dot_product_attention(q, k, v, causal=self.causal,
+                                        bias=bias)
+        ctx = ctx.astype(x.dtype)
         return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
                                param_dtype=jnp.float32, name="out")(ctx)
 
@@ -48,11 +79,15 @@ class BertLayer(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: Any = jnp.float32
+    attention_impl: str = "full"
+    sp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
         d = x.shape[-1]
         attn = BertSelfAttention(self.num_heads, self.dtype,
+                                 attention_impl=self.attention_impl,
+                                 sp_axis=self.sp_axis,
                                  name="attention")(x, mask)
         x = FusedLayerNorm(normalized_shape=d, name="attention_ln")(
             x + attn).astype(x.dtype)
@@ -75,6 +110,8 @@ class BertEncoder(nn.Module):
     type_vocab_size: int = 2
     num_classes: Optional[int] = 2     # fine-tune head; None = features
     dtype: Any = jnp.float32
+    attention_impl: str = "full"       # full | blockwise | ring | ulysses
+    sp_axis: Optional[str] = None      # mesh axis for ring/ulysses
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
@@ -82,9 +119,14 @@ class BertEncoder(nn.Module):
         emb = nn.Embed(self.vocab_size, self.hidden_size,
                        param_dtype=jnp.float32, name="word_embeddings")(
                            input_ids)
+        pos_ids = jnp.arange(s)[None, :]
+        if self.sp_axis is not None:
+            # Sequence-sharded: this shard's global positions start at
+            # rank * local_len.
+            pos_ids = pos_ids + jax.lax.axis_index(self.sp_axis) * s
         pos = nn.Embed(self.max_len, self.hidden_size,
                        param_dtype=jnp.float32, name="position_embeddings")(
-                           jnp.arange(s)[None, :])
+                           pos_ids)
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         typ = nn.Embed(self.type_vocab_size, self.hidden_size,
@@ -95,12 +137,24 @@ class BertEncoder(nn.Module):
         x = x.astype(self.dtype)
         for i in range(self.num_layers):
             x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
+                          attention_impl=self.attention_impl,
+                          sp_axis=self.sp_axis,
                           name=f"layer_{i}")(x, attention_mask)
         if self.num_classes is None:
             return x.astype(jnp.float32)
+        if self.sp_axis is not None:
+            # Sequence-sharded: only sp-rank 0 holds the true [CLS] token.
+            # Recover it exactly on every rank with a masked psum, so the
+            # sp and non-sp modes compute the SAME function and params are
+            # interchangeable between them.
+            is_rank0 = (jax.lax.axis_index(self.sp_axis) == 0)
+            contrib = jnp.where(is_rank0, x[:, 0].astype(jnp.float32), 0.0)
+            pool_in = jax.lax.psum(contrib, self.sp_axis).astype(x.dtype)
+        else:
+            pool_in = x[:, 0]
         pooled = jnp.tanh(nn.Dense(self.hidden_size, dtype=self.dtype,
                                    param_dtype=jnp.float32,
-                                   name="pooler")(x[:, 0]))
+                                   name="pooler")(pool_in))
         logits = nn.Dense(self.num_classes, dtype=self.dtype,
                           param_dtype=jnp.float32, name="classifier")(pooled)
         return logits.astype(jnp.float32)

@@ -1,0 +1,146 @@
+"""Loader for the native C++ runtime (``apex_tpu/csrc/apex_runtime.cpp``).
+
+Mirrors the reference's two-tier install contract (SURVEY.md §1: "a
+Python-only install must remain fully functional"): the .so is built on
+first use with g++ if available; every entry point has a numpy fallback, and
+``available`` reports which tier is active — the analog of
+``multi_tensor_applier.available``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_SO = os.path.join(_CSRC, "build", "libapex_tpu_runtime.so")
+_lock = threading.Lock()
+_lib = None
+available = False
+
+
+def _build() -> Optional[str]:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    src = os.path.join(_CSRC, "apex_runtime.cpp")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           src, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def _load():
+    global _lib, available
+    with _lock:
+        if _lib is not None or available is None:
+            return _lib
+        path = _SO if os.path.exists(_SO) else _build()
+        if path is None:
+            available = False
+            _lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            assert lib.apex_runtime_abi_version() == 1
+        except Exception:
+            available = False
+            _lib = False
+            return None
+        lib.apex_flatten.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+        lib.apex_unflatten.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+        lib.apex_u8_to_f32_nhwc.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        _lib = lib
+        available = True
+        return lib
+
+
+_DEFAULT_THREADS = max(1, (os.cpu_count() or 1) - 1)
+
+
+def flatten(arrays: Sequence[np.ndarray], threads: int = _DEFAULT_THREADS
+            ) -> np.ndarray:
+    """Pack host arrays into one contiguous byte buffer (reference
+    ``apex_C.flatten``, csrc/flatten_unflatten.cpp)."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    sizes = np.array([a.nbytes for a in arrays], np.int64)
+    out = np.empty(int(sizes.sum()), np.uint8)
+    lib = _load()
+    if lib:
+        srcs = (ctypes.c_void_p * len(arrays))(
+            *[a.ctypes.data for a in arrays])
+        lib.apex_flatten(srcs, sizes.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)), len(arrays),
+            out.ctypes.data_as(ctypes.c_void_p), threads)
+    else:
+        off = 0
+        for a, n in zip(arrays, sizes):
+            out[off:off + n] = a.view(np.uint8).reshape(-1)
+            off += int(n)
+    return out
+
+
+def unflatten(flat: np.ndarray, like: Sequence[np.ndarray],
+              threads: int = _DEFAULT_THREADS) -> List[np.ndarray]:
+    """Split a flat byte buffer back into arrays shaped like ``like``
+    (reference ``apex_C.unflatten``)."""
+    flat = np.ascontiguousarray(flat.view(np.uint8).reshape(-1))
+    outs = [np.empty(a.shape, a.dtype) for a in like]
+    sizes = np.array([a.nbytes for a in outs], np.int64)
+    if int(sizes.sum()) != flat.nbytes:
+        raise ValueError(f"flat buffer has {flat.nbytes} bytes, "
+                         f"targets need {int(sizes.sum())}")
+    lib = _load()
+    if lib:
+        dsts = (ctypes.c_void_p * len(outs))(
+            *[o.ctypes.data for o in outs])
+        lib.apex_unflatten(flat.ctypes.data_as(ctypes.c_void_p),
+                           sizes.ctypes.data_as(
+                               ctypes.POINTER(ctypes.c_int64)),
+                           len(outs), dsts, threads)
+    else:
+        off = 0
+        for o, n in zip(outs, sizes):
+            o.view(np.uint8).reshape(-1)[:] = flat[off:off + int(n)]
+            off += int(n)
+    return outs
+
+
+def u8_to_f32_nhwc(images: np.ndarray, mean: Sequence[float],
+                   std: Sequence[float],
+                   threads: int = _DEFAULT_THREADS) -> np.ndarray:
+    """Normalize a uint8 NHWC batch to float32: ``(x/255 - mean)/std`` —
+    the input-pipeline decode epilogue (the reference's examples lean on
+    DALI for this)."""
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if mean.size != c or std.size != c:
+        raise ValueError("mean/std length must equal channel count")
+    out = np.empty((n, h, w, c), np.float32)
+    lib = _load()
+    if lib:
+        lib.apex_u8_to_f32_nhwc(
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, h * w, c,
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), threads)
+    else:
+        out[:] = (images.astype(np.float32) / 255.0 - mean) / std
+    return out

@@ -1,0 +1,11 @@
+"""apex_tpu.ops — TPU-first compute ops (attention and friends).
+
+Beyond-parity scope: the reference has no attention code at all
+(SURVEY.md §5 "Long-context / sequence parallelism: absent"), but a
+TPU-native framework needs long-context attention as a first-class op —
+it shapes the sharding design (ring/Ulysses sequence parallelism in
+``apex_tpu.parallel``).
+"""
+
+from .attention import (blockwise_attention, mha_attention,  # noqa: F401
+                        dot_product_attention)

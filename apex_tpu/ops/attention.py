@@ -1,0 +1,164 @@
+"""Blockwise (flash-style) attention with online softmax.
+
+The memory-efficient attention core: never materializes the [T, S] score
+matrix; streams KV blocks through a ``lax.scan`` carrying running
+(max, denominator, accumulator) — the standard online-softmax recurrence.
+Under XLA this compiles to a tight loop whose matmuls hit the MXU; wrapped
+in ``jax.checkpoint`` the backward recomputes per-block, giving O(T) memory.
+
+This is also the *local* op of ring attention
+(``apex_tpu/parallel/ring_attention.py``): each ring step feeds one rotated
+KV shard through the same recurrence, so single-device and ring results
+agree to numerical precision.
+
+Shapes follow the JAX convention ``[batch, seq, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, sm_scale):
+    # [B, H, Tq, Tk] scores for one KV block; fp32 accumulation on the MXU.
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * sm_scale
+
+
+def _causal_mask(q_offset, k_offset, tq, tk):
+    qi = q_offset + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    ki = k_offset + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return qi >= ki
+
+
+def attention_block_update(q, k, v, m_prev, l_prev, acc_prev, *,
+                           sm_scale, causal=False, q_offset=0, k_offset=0,
+                           bias=None):
+    """One online-softmax update with a KV block.
+
+    Carry: ``m`` running row max [B,H,Tq], ``l`` running denominator
+    [B,H,Tq], ``acc`` unnormalized output [B,Tq,H,D].  Returns the updated
+    carry.  ``q_offset``/``k_offset`` are the global positions of the first
+    query/key in these blocks (needed for causal masking across ring steps /
+    scan blocks); either may be a traced scalar.
+    """
+    s = _block_scores(q, k, sm_scale)                       # [B,H,Tq,Tk]
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        mask = _causal_mask(q_offset, k_offset, q.shape[1], k.shape[1])
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))        # [B,H,Tq]
+    # Guard fully-masked rows: keep exp finite.
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)                         # [B,H,Tq]
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    acc_new = acc_prev * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def _init_carry(batch, tq, heads, dim):
+    m = jnp.full((batch, heads, tq), NEG_INF, jnp.float32)
+    l = jnp.zeros((batch, heads, tq), jnp.float32)
+    acc = jnp.zeros((batch, tq, heads, dim), jnp.float32)
+    return m, l, acc
+
+
+def finalize_attention(m, l, acc, dtype):
+    """Normalize the accumulator; fully-masked rows produce zeros."""
+    l_t = l.transpose(0, 2, 1)[..., None]                   # [B,Tq,H,1]
+    safe = jnp.where(l_t == 0.0, 1.0, l_t)
+    return (acc / safe).astype(dtype)
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        block_size: int = 512,
+                        q_offset=0, k_offset=0,
+                        bias=None):
+    """Flash-style attention over KV blocks.  [B,T,H,D] in and out."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    blk = min(block_size, tk)
+    n_blocks = tk // blk
+    rem = tk - n_blocks * blk       # trailing partial block (static)
+    carry = _init_carry(b, tq, h, d)
+
+    if n_blocks == 1 and rem == 0:
+        m, l, acc = attention_block_update(
+            q, k, v, *carry, sm_scale=sm_scale, causal=causal,
+            q_offset=q_offset, k_offset=k_offset, bias=bias)
+        return finalize_attention(m, l, acc, q.dtype)
+
+    if n_blocks > 0:
+        tk_main = n_blocks * blk
+        k_blocks = k[:, :tk_main].reshape(
+            b, n_blocks, blk, h, d).transpose(1, 0, 2, 3, 4)
+        v_blocks = v[:, :tk_main].reshape(
+            b, n_blocks, blk, h, d).transpose(1, 0, 2, 3, 4)
+        if bias is not None:
+            bias_blocks = bias[..., :tk_main].reshape(
+                *bias.shape[:-1], n_blocks, blk)
+            bias_blocks = jnp.moveaxis(bias_blocks, -2, 0)
+        else:
+            bias_blocks = None
+
+        @functools.partial(jax.checkpoint, prevent_cse=False)
+        def step(carry, inputs):
+            i, kb, vb = inputs[0], inputs[1], inputs[2]
+            bb = inputs[3] if bias_blocks is not None else None
+            m, l, acc = carry
+            m, l, acc = attention_block_update(
+                q, kb, vb, m, l, acc, sm_scale=sm_scale, causal=causal,
+                q_offset=q_offset, k_offset=k_offset + i * blk, bias=bb)
+            return (m, l, acc), None
+
+        idx = jnp.arange(n_blocks)
+        xs = (idx, k_blocks, v_blocks)
+        if bias_blocks is not None:
+            xs = xs + (bias_blocks,)
+        carry, _ = lax.scan(step, carry, xs)
+
+    if rem:
+        # Remainder block — still O(blk)-sized scores, never the full [T,S].
+        m, l, acc = attention_block_update(
+            q, k[:, -rem:], v[:, -rem:], *carry, sm_scale=sm_scale,
+            causal=causal, q_offset=q_offset,
+            k_offset=k_offset + n_blocks * blk,
+            bias=None if bias is None else bias[..., -rem:])
+        carry = (m, l, acc)
+
+    return finalize_attention(*carry, q.dtype)
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          sm_scale: Optional[float] = None, bias=None):
+    """Reference (non-blockwise) attention — the numerics oracle."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = _block_scores(q, k, sm_scale)
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        mask = _causal_mask(0, 0, q.shape[1], k.shape[1])
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def mha_attention(q, k, v, **kw):
+    """Alias choosing the blockwise path (public name)."""
+    return blockwise_attention(q, k, v, **kw)

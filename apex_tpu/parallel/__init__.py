@@ -17,6 +17,7 @@ from .distributed import (DistributedDataParallel, Reducer,  # noqa: F401
                           reduce_gradients, broadcast_params)
 from .sync_batchnorm import SyncBatchNorm, welford_parallel  # noqa: F401
 from .LARC import LARC, larc_transform, larc_gradients       # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 
 
 def convert_syncbn_model(module: nn.Module, axis_name: str = "data",

@@ -81,8 +81,19 @@ def reduce_gradients(grads,
     optional fp32 upcast, predivide by ``gradient_predivide_factor`` before
     the reduce and postdivide by ``world/predivide`` after, so reduced-
     precision sums stay in range.
+
+    ``axis_name`` may be a tuple of mesh axes (e.g. ``("data", "sp")``) —
+    the DP contract then spans their product, as when a model is replicated
+    over a 2-D data × sequence-parallel mesh.  ``axis_index_groups``
+    requires a single axis.
     """
-    full_world = lax.axis_size(axis_name)
+    axis_names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if len(axis_names) > 1 and axis_index_groups:
+        raise ValueError("axis_index_groups requires a single axis name")
+    full_world = 1
+    for a in axis_names:
+        full_world *= lax.axis_size(a)
+    explicit_world = world_size is not None
     if world_size is None:
         world_size = full_world
         if axis_index_groups:
@@ -94,7 +105,8 @@ def reduce_gradients(grads,
     # transpose does not insert a psum either, so grads arrive per-shard.
     # axis_index is axis-varying by construction, so it probes tracking.
     try:
-        _vma_tracking = axis_name in jax.typeof(lax.axis_index(axis_name)).vma
+        _vma_tracking = axis_names[0] in jax.typeof(
+            lax.axis_index(axis_names[0])).vma
     except Exception:
         _vma_tracking = False
 
@@ -110,15 +122,28 @@ def reduce_gradients(grads,
             vma = jax.typeof(g).vma
         except AttributeError:
             return False
-        return axis_name not in vma
+        return not any(a in vma for a in axis_names)
+
+    def _axes_still_varying(g):
+        """Mesh axes this grad still varies over (needs explicit psum);
+        axes absent from the vma set were already summed by shard_map's
+        implicit-broadcast transpose."""
+        if not _vma_tracking:
+            return axis_names
+        try:
+            vma = jax.typeof(g).vma
+        except AttributeError:
+            return axis_names
+        return tuple(a for a in axis_names if a in vma)
 
     def one(g):
         if not _is_float(g):
             return g
-        if _already_reduced(g):
-            # The implicit psum summed over the FULL axis (subgroup structure
-            # is invisible to shard_map's transpose), so average over the
-            # full axis size regardless of axis_index_groups.
+        need = _axes_still_varying(g)
+        if not need:
+            # Fully pre-summed by the implicit psum — which spans the FULL
+            # axes (subgroup structure is invisible to the transpose), so
+            # average over the full product regardless of axis_index_groups.
             if gradient_average:
                 return (g / full_world).astype(jnp.asarray(g).dtype)
             return g
@@ -127,9 +152,16 @@ def reduce_gradients(grads,
             g = jnp.asarray(g, jnp.float32)
         if gradient_predivide_factor != 1.0:
             g = g / gradient_predivide_factor
-        g = group_psum(g, axis_name, axis_index_groups)
+        g = group_psum(g, need if len(need) > 1 else need[0],
+                       axis_index_groups)
         if gradient_average:
-            postdiv = world_size / gradient_predivide_factor
+            # After implicit (axes not in `need`) + explicit sums the grad
+            # is summed over the full product; with subgroups (single axis,
+            # nothing implicit) it is summed over the group only.  An
+            # explicitly passed world_size always wins (public contract).
+            denom = (world_size if (axis_index_groups or explicit_world)
+                     else full_world)
+            postdiv = denom / gradient_predivide_factor
             if postdiv != 1.0:
                 g = g / postdiv
         elif gradient_predivide_factor != 1.0:

@@ -1,0 +1,136 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Beyond-parity scope (the reference has no attention or sequence parallelism
+— SURVEY.md §2.10/§5); first-class here because long-context training is a
+core TPU workload and shapes the mesh design.
+
+Two strategies over a mesh axis ``sp`` holding sequence shards:
+
+* **Ring attention** (:func:`ring_attention`) — Q stays resident; KV shards
+  rotate around the ring via ``lax.ppermute`` while each device accumulates
+  the online-softmax recurrence (``ops.attention.attention_block_update``).
+  Communication rides ICI neighbor links (a ``ppermute`` ring), overlapping
+  with the per-block matmuls; memory is O(T/n) per device.  Causal masking
+  uses each block's global offsets, so rotated blocks mask correctly.
+
+* **Ulysses** (:func:`ulysses_attention`) — two ``all_to_all``s re-shard
+  from sequence-sharded to head-sharded, run *local* full attention, and
+  shard back.  Cheaper at moderate T (2 collectives instead of n-1
+  permutes) but caps parallelism at num_heads.
+
+Both are pure functions designed for use inside ``shard_map`` and agree
+with single-device blockwise attention to numerical precision (see
+tests/test_ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import (attention_block_update, _init_carry,
+                             finalize_attention, blockwise_attention)
+
+
+def ring_attention(q, k, v, axis_name: str, *,
+                   causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   block_size: int = 512):
+    """Ring attention over sequence shards (inside shard_map).
+
+    ``q``/``k``/``v``: local shards [B, T/n, H, D] where the global sequence
+    is split contiguously over ``axis_name`` in rank order.  Returns the
+    local output shard [B, T/n, H, D].
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    q_offset = idx * t_local
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Sub-block the local shard when it exceeds block_size, bounding the
+    # per-step score matrix at [B,H,T/n,block_size].
+    blk = min(block_size, t_local)
+    n_sub = t_local // blk
+    rem = t_local - n_sub * blk
+
+    def _consume_shard(kb, vb, m, l, acc, k_offset):
+        if n_sub <= 1 and rem == 0:
+            return attention_block_update(
+                q, kb, vb, m, l, acc, sm_scale=sm_scale, causal=causal,
+                q_offset=q_offset, k_offset=k_offset)
+        for s in range(n_sub):
+            m, l, acc = attention_block_update(
+                q, kb[:, s * blk:(s + 1) * blk], vb[:, s * blk:(s + 1) * blk],
+                m, l, acc, sm_scale=sm_scale, causal=causal,
+                q_offset=q_offset, k_offset=k_offset + s * blk)
+        if rem:
+            m, l, acc = attention_block_update(
+                q, kb[:, -rem:], vb[:, -rem:], m, l, acc,
+                sm_scale=sm_scale, causal=causal, q_offset=q_offset,
+                k_offset=k_offset + n_sub * blk)
+        return m, l, acc
+
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def step(carry, r):
+        kv, m, l, acc = carry
+        kb, vb = kv
+        # This KV block originated at rank (idx - r) mod n.
+        k_offset = ((idx - r) % n) * t_local
+        m, l, acc = _consume_shard(kb, vb, m, l, acc, k_offset)
+        # Rotate for the next step (skipped result on the last iteration
+        # costs nothing: XLA dead-code-eliminates... but ppermute is a
+        # collective every rank must execute, so keep it unconditional).
+        kv = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis_name, perm), kv)
+        return (kv, m, l, acc), None
+
+    m0, l0, acc0 = _init_carry(b, t_local, h, d)
+    # The zeros carry is axis-unvarying but the body produces values varying
+    # over every manual axis q varies over (sp, plus e.g. data on a 2-D
+    # mesh); align the vma types up front (shard_map scan requirement).
+    try:
+        target_vma = tuple(jax.typeof(q).vma | {axis_name})
+    except AttributeError:          # vma tracking off / pmap trace
+        target_vma = (axis_name,)
+    m0, l0, acc0 = jax.tree_util.tree_map(
+        lambda x: lax.pcast(x, target_vma, to="varying"), (m0, l0, acc0))
+    (_, m, l, acc), _ = lax.scan(step, ((k, v), m0, l0, acc0),
+                                 jnp.arange(n))
+    return finalize_attention(m, l, acc, q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, *,
+                      causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      block_size: int = 512):
+    """Ulysses-style all-to-all sequence parallelism (inside shard_map).
+
+    Local shards [B, T/n, H, D] → all_to_all → [B, T, H/n, D] → local
+    blockwise attention over the FULL sequence → all_to_all back.
+    Requires ``H % n == 0``.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"num_heads {h} not divisible by axis size {n}")
+
+    def seq_to_heads(x):
+        # split heads (axis 2) across ranks, gather sequence (axis 1)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = blockwise_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale,
+                              block_size=block_size)
+    return heads_to_seq(out)

@@ -1,0 +1,14 @@
+"""apex_tpu.prof — profiling toolkit (reference ``apex/pyprof``).
+
+Three stages, mapped TPU-natively (SURVEY.md §2.9, §5):
+
+1. capture  → :mod:`apex_tpu.prof.capture` (named scopes into HLO metadata,
+   ``jax.profiler`` device traces, optional arg markers).
+2. parse    → the jaxpr/compiled-HLO *is* the database; no SQLite.
+3. prof     → :mod:`apex_tpu.prof.analysis` (per-op flops/bytes/intensity
+   records, MXU-eligibility column, XLA cost-model cross-check).
+"""
+
+from .analysis import OpRecord, Profile, profile_function   # noqa: F401
+from .capture import (init, annotate, scope, trace,          # noqa: F401
+                      dump_markers, MARKERS)

@@ -1,0 +1,229 @@
+"""Per-op FLOPs/bytes analysis of traced computations — the ``pyprof.prof``
+stage (reference ``apex/pyprof/prof/``: per-op analyzer classes computing
+bytes/flops from captured shapes, e.g. ``conv.py:190-233``).
+
+The reference reconstructs op shapes from NVTX markers recorded in a CUPTI
+SQLite DB.  On TPU the compiler already *has* the whole program: we walk the
+jaxpr of the jitted function (recursing through pjit/scan/cond/custom-vjp
+calls) and emit one :class:`OpRecord` per primitive with analytic FLOPs and
+memory traffic, and cross-check totals against XLA's own
+``compiled.cost_analysis()`` — the profiler-DB role is played by the
+compiler, with no host-side capture overhead at all.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import core as jcore
+
+
+@dataclass
+class OpRecord:
+    """One primitive invocation (reference ``pyprof/prof/data.py`` Data)."""
+    index: int
+    op: str                     # primitive name
+    name: str                   # named_scope path if present
+    in_shapes: list
+    in_dtypes: list
+    out_shapes: list
+    out_dtypes: list
+    flops: float                # analytic floating ops
+    bytes: float                # analytic HBM traffic (read + write)
+    count: int = 1              # multiplicity (e.g. scan length)
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity flop/byte — the roofline coordinate."""
+        return self.flops / self.bytes if self.bytes else 0.0
+
+
+def _size(aval) -> int:
+    return math.prod(aval.shape) if aval.shape else 1
+
+
+def _bytesize(aval) -> int:
+    return _size(aval) * jnp.dtype(aval.dtype).itemsize
+
+
+def _dot_general_flops(eqn) -> float:
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    batch = math.prod(lhs.shape[d] for d in lb) if lb else 1
+    contract = math.prod(lhs.shape[d] for d in lc) if lc else 1
+    m = math.prod(s for i, s in enumerate(lhs.shape)
+                  if i not in lc and i not in lb)
+    n = math.prod(s for i, s in enumerate(rhs.shape)
+                  if i not in rc and i not in rb)
+    return 2.0 * batch * m * n * contract
+
+
+def _conv_flops(eqn) -> float:
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    # 2 * output elements * (kernel spatial * in_features / groups)
+    groups = eqn.params.get("feature_group_count", 1)
+    dn = eqn.params["dimension_numbers"]
+    k_spatial = math.prod(rhs.shape[i] for i in dn.rhs_spec[2:])
+    cin = rhs.shape[dn.rhs_spec[1]]
+    return 2.0 * _size(out) * k_spatial * cin  # cin already per-group
+
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "neg", "exp", "log", "tanh",
+    "logistic", "rsqrt", "sqrt", "pow", "integer_pow", "abs", "sign",
+    "floor", "ceil", "round", "erf", "select_n", "clamp", "and", "or",
+    "xor", "not", "eq", "ne", "ge", "gt", "le", "lt", "convert_element_type",
+    "erf_inv", "expm1", "log1p", "cos", "sin", "tan", "atan2", "cbrt",
+    "real", "imag", "stop_gradient", "copy", "nextafter", "squeeze",
+}
+
+_REDUCTIONS = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+               "reduce_and", "reduce_or", "argmax", "argmin",
+               "cumsum", "cumprod", "cummax", "cummin"}
+
+_TRANSCENDENTAL_COST = {"exp": 1, "log": 1, "tanh": 1, "logistic": 1,
+                        "erf": 1, "pow": 1}
+
+_CALL_PRIMS = {"pjit", "closed_call", "core_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "checkpoint",
+               "custom_lin", "named_call"}
+
+
+def _inner_jaxpr(eqn):
+    p = eqn.params
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in p and p[key] is not None:
+            j = p[key]
+            return j.jaxpr if hasattr(j, "jaxpr") else j
+    return None
+
+
+def _flops_bytes(eqn):
+    """Analytic (flops, bytes) for one primitive."""
+    prim = eqn.primitive.name
+    in_bytes = sum(_bytesize(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval"))
+    out_bytes = sum(_bytesize(v.aval) for v in eqn.outvars)
+    total_bytes = in_bytes + out_bytes
+    out_elems = sum(_size(v.aval) for v in eqn.outvars)
+
+    if prim == "dot_general":
+        return _dot_general_flops(eqn), total_bytes
+    if prim == "conv_general_dilated":
+        return _conv_flops(eqn), total_bytes
+    if prim in _REDUCTIONS:
+        in_elems = sum(_size(v.aval) for v in eqn.invars
+                       if hasattr(v, "aval"))
+        return float(in_elems), total_bytes
+    if prim in _ELEMENTWISE:
+        return float(out_elems), total_bytes
+    # data movement (reshape/transpose/slice/gather/...): 0 flops
+    return 0.0, total_bytes
+
+
+class Profile:
+    """Result of :func:`profile_function` — records + totals + summary."""
+
+    def __init__(self, records: List[OpRecord],
+                 xla_cost: Optional[dict] = None):
+        self.records = records
+        self.xla_cost = xla_cost or {}
+
+    @property
+    def total_flops(self) -> float:
+        return sum(r.flops * r.count for r in self.records)
+
+    @property
+    def total_bytes(self) -> float:
+        return sum(r.bytes * r.count for r in self.records)
+
+    def by_op(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for r in self.records:
+            out[r.op] = out.get(r.op, 0.0) + r.flops * r.count
+        return out
+
+    def summary(self, top: int = 20) -> str:
+        """Tabular report (reference ``pyprof/prof/output.py`` columns:
+        op, params, flops, bytes, tensor-core/MXU eligibility)."""
+        rows = sorted(self.records, key=lambda r: -(r.flops * r.count))[:top]
+        lines = ["{:<5} {:<22} {:>14} {:>14} {:>9} {:>5}  {}".format(
+            "idx", "op", "flops", "bytes", "intens", "MXU", "shapes")]
+        for r in rows:
+            mxu = "yes" if r.op in ("dot_general",
+                                    "conv_general_dilated") else ""
+            lines.append("{:<5} {:<22} {:>14.3g} {:>14.3g} {:>9.2f} {:>5}  {}"
+                         .format(r.index, r.op, r.flops * r.count,
+                                 r.bytes * r.count, r.intensity, mxu,
+                                 "{}->{}".format(r.in_shapes, r.out_shapes)))
+        lines.append("TOTAL flops={:.4g} bytes={:.4g}  (xla: flops={} "
+                     "bytes accessed={})".format(
+                         self.total_flops, self.total_bytes,
+                         self.xla_cost.get("flops", "n/a"),
+                         self.xla_cost.get("bytes accessed", "n/a")))
+        return "\n".join(lines)
+
+
+def _walk(jaxpr, records: List[OpRecord], scope: str, mult: int):
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        inner = _inner_jaxpr(eqn)
+        if prim == "scan":
+            length = eqn.params.get("length", 1)
+            _walk(inner, records, scope + f"/scan", mult * length)
+            continue
+        if prim == "while":
+            body = eqn.params.get("body_jaxpr")
+            body = body.jaxpr if hasattr(body, "jaxpr") else body
+            if body is not None:
+                _walk(body, records, scope + "/while", mult)
+            continue
+        if prim == "cond":
+            for br in eqn.params.get("branches", ()):
+                _walk(br.jaxpr if hasattr(br, "jaxpr") else br,
+                      records, scope + "/cond", mult)
+            continue
+        if inner is not None or prim in _CALL_PRIMS:
+            if inner is not None:
+                name = eqn.params.get("name", prim)
+                _walk(inner, records, f"{scope}/{name}", mult)
+                continue
+        flops, nbytes = _flops_bytes(eqn)
+        records.append(OpRecord(
+            index=len(records), op=prim, name=scope,
+            in_shapes=[tuple(v.aval.shape) for v in eqn.invars
+                       if hasattr(v, "aval")],
+            in_dtypes=[str(v.aval.dtype) for v in eqn.invars
+                       if hasattr(v, "aval") and hasattr(v.aval, "dtype")],
+            out_shapes=[tuple(v.aval.shape) for v in eqn.outvars],
+            out_dtypes=[str(v.aval.dtype) for v in eqn.outvars
+                        if hasattr(v.aval, "dtype")],
+            flops=flops, bytes=nbytes, count=mult))
+
+
+def profile_function(fn: Callable, *args, xla_cost: bool = True,
+                     **kwargs) -> Profile:
+    """Trace ``fn(*args)`` and return a :class:`Profile`.
+
+    The parse stage of pyprof (``pyprof/parse``) reads a profiler database;
+    here the jaxpr IS the database.  With ``xla_cost=True`` the function is
+    also lowered + compiled so XLA's own cost model is attached.
+    """
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    records: List[OpRecord] = []
+    _walk(jaxpr.jaxpr, records, "", 1)
+    cost = None
+    if xla_cost:
+        try:
+            compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+        except Exception:
+            cost = None
+    return Profile(records, cost)

@@ -1,0 +1,91 @@
+"""Scope annotation + device-trace capture — the ``pyprof.nvtx`` stage.
+
+The reference monkey-patches every torch function to push NVTX ranges with
+op name/shapes (``pyprof/nvtx/nvmarker.py:67-213``).  Under jit that
+technique is hostile to tracing; the TPU-native equivalents are:
+
+* :func:`annotate` / :func:`scope` — ``jax.named_scope`` wrappers; the scope
+  names flow into HLO metadata and show up in XLA profiler traces (the NVTX
+  range analog, visible in Perfetto/TensorBoard).
+* :func:`init` — reference API parity (``pyprof.nvtx.init()``): installs
+  nothing globally (nothing to patch — tracing sees every op anyway) but
+  flips a flag so :func:`annotate` records call markers with arg shapes
+  into :data:`MARKERS`, mirroring the reference's traceMarker/argMarker
+  dicts for tooling that consumed them.
+* :func:`trace` — context manager around ``jax.profiler.trace`` (the
+  ``nvprof -o net.sql`` analog; output is a TensorBoard/Perfetto trace
+  directory instead of a CUPTI SQLite DB).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+from typing import Any, Callable, List
+
+import jax
+
+MARKERS: List[dict] = []
+_enabled = False
+
+
+def init(enable_markers: bool = True) -> None:
+    """Reference ``pyprof.nvtx.init()`` parity (nvmarker.py:206-213)."""
+    global _enabled
+    _enabled = enable_markers
+
+
+def _arg_marker(fn_name: str, args, kwargs) -> dict:
+    def describe(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return {"shape": tuple(int(s) for s in x.shape),
+                    "dtype": str(x.dtype)}
+        if isinstance(x, (int, float, bool, str)) or x is None:
+            return {"value": x}
+        return {"type": type(x).__name__}
+    return {"op": fn_name,
+            "args": [describe(a) for a in args],
+            "kwargs": {k: describe(v) for k, v in kwargs.items()}}
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Named scope context; name lands in HLO metadata / profiler traces."""
+    with jax.named_scope(name):
+        yield
+
+
+def annotate(name: str = None) -> Callable:
+    """Decorator: run the function under a named scope and (when
+    :func:`init` was called) record an arg marker per trace."""
+    def deco(fn):
+        scope_name = name or getattr(fn, "__name__", "fn")
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            if _enabled:
+                MARKERS.append(_arg_marker(scope_name, args, kwargs))
+            with jax.named_scope(scope_name):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace (XLA profiler) to ``logdir`` — open with
+    TensorBoard or Perfetto.  The ``emit_nvtx + nvprof`` analog."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def dump_markers(path: str) -> None:
+    """Write collected markers as JSON lines (the ``net.dict`` analog the
+    reference's ``parse`` stage emits for the ``prof`` stage)."""
+    with open(path, "w") as f:
+        for m in MARKERS:
+            f.write(json.dumps(m) + "\n")

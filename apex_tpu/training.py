@@ -45,6 +45,21 @@ from .optimizers import functional as F
 from .parallel.distributed import reduce_gradients
 
 
+def _pmean_varying(x, axis_name):
+    """pmean over only the axes ``x`` actually varies on (pmean over an
+    invarying axis is rejected by shard_map's vma checking — and would be
+    the identity anyway)."""
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    try:
+        vma = jax.typeof(x).vma
+        names = tuple(a for a in names if a in vma)
+    except AttributeError:
+        pass
+    if names:
+        return jax.lax.pmean(x, names)
+    return x
+
+
 class FunctionalOptimizer(NamedTuple):
     init: Callable
     update: Callable      # (grads, state, params, lr, grad_scale, apply_mask)
@@ -176,8 +191,16 @@ def make_train_step(loss_fn: Callable,
 
         if axis_name is not None:
             # Replicated metric, like the reference examples' allreduced
-            # loss prints (main_amp.py:356-394).
-            loss = jax.lax.pmean(loss, axis_name)
+            # loss prints (main_amp.py:356-394); batch stats (BN running
+            # mean/var) averaged across replicas so the carried state stays
+            # replicated — the reference leaves stats per-rank, which only
+            # works because each rank owns its module copy; under SPMD a
+            # replicated pytree is the contract.  Each value is averaged
+            # only over axes it actually varies on.
+            loss = _pmean_varying(loss, axis_name)
+            if new_ms is not None:
+                new_ms = jax.tree_util.tree_map(
+                    lambda x: _pmean_varying(x, axis_name), new_ms)
         metrics = {"loss": loss,
                    "loss_scale": scaler_state.loss_scale,
                    "overflow": (jnp.logical_not(apply_mask)

@@ -1,0 +1,134 @@
+"""DCGAN with amp — the TPU port of the reference
+``examples/dcgan/main_amp.py:214-253``: two models, two optimizers, THREE
+losses with separate loss scalers (``amp.initialize(..., num_losses=3)``,
+``loss_id=0/1/2``), exercised through the imperative amp surface.
+
+    python main_amp.py --niter 1 --batchSize 64 --opt_level O1
+"""
+
+import os as _os
+import sys as _sys
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), *[_os.pardir] * 2)))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.models import Generator, Discriminator
+from apex_tpu.optimizers import FusedAdam
+
+
+def parse():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batchSize", type=int, default=64)
+    p.add_argument("--nz", type=int, default=100)
+    p.add_argument("--ngf", type=int, default=64)
+    p.add_argument("--ndf", type=int, default=64)
+    p.add_argument("--niter", type=int, default=1)
+    p.add_argument("--iters-per-epoch", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.0002)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--opt_level", type=str, default="O1")
+    return p.parse_args()
+
+
+def bce_with_logits(logits, target):
+    z = logits.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(z, 0) - z * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(z))))
+
+
+def main():
+    opt = parse()
+    key = jax.random.PRNGKey(0)
+    netG = Generator(ngf=opt.ngf, nc=3)
+    netD = Discriminator(ndf=opt.ndf)
+
+    z0 = jnp.ones((opt.batchSize, opt.nz))
+    gv = netG.init(key, z0)
+    img0 = netG.apply(gv, z0, train=False)
+    dv = netD.init(jax.random.PRNGKey(1), img0)
+
+    optimizerG = FusedAdam(gv["params"], lr=opt.lr, betas=(opt.beta1, 0.999))
+    optimizerD = FusedAdam(dv["params"], lr=opt.lr, betas=(opt.beta1, 0.999))
+
+    # Multi-model / multi-optimizer / multi-loss init (reference
+    # main_amp.py:214-215).
+    [gp, dp], [optimizerG, optimizerD] = amp.initialize(
+        [optimizerG.params, optimizerD.params], [optimizerG, optimizerD],
+        opt_level=opt.opt_level, num_losses=3)
+
+    g_state = {k: v for k, v in gv.items() if k != "params"}
+    d_state = {k: v for k, v in dv.items() if k != "params"}
+    real_label, fake_label = 1.0, 0.0
+
+    def d_loss_real(d_params, real):
+        out, _ = netD.apply({"params": d_params, **d_state}, real,
+                            train=True, mutable=["batch_stats"])
+        return bce_with_logits(out, real_label)
+
+    def d_loss_fake(d_params, fake):
+        out, _ = netD.apply({"params": d_params, **d_state}, fake,
+                            train=True, mutable=["batch_stats"])
+        return bce_with_logits(out, fake_label)
+
+    def g_loss(g_params, d_params, noise):
+        fake, _ = netG.apply({"params": g_params, **g_state}, noise,
+                             train=True, mutable=["batch_stats"])
+        out, _ = netD.apply({"params": d_params, **d_state}, fake,
+                            train=True, mutable=["batch_stats"])
+        return bce_with_logits(out, real_label)
+
+    # jit the three grad computations once — the amp O1 policy is a
+    # trace-time decision, so compiled steps see the same cast policy.
+    vg_d_real = jax.jit(optimizerD.value_and_grad(d_loss_real))
+    vg_d_fake = jax.jit(optimizerD.value_and_grad(d_loss_fake))
+    gen = jax.jit(lambda gp_, n: netG.apply(
+        {"params": gp_, **g_state}, n, train=True,
+        mutable=["batch_stats"])[0])
+    vg_g = jax.jit(optimizerG.value_and_grad(g_loss))
+
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    for epoch in range(opt.niter):
+        for i in range(opt.iters_per_epoch):
+            real = jnp.asarray(rng.randn(opt.batchSize, 64, 64, 3) * 0.5,
+                               jnp.float32)
+            noise = jnp.asarray(rng.randn(opt.batchSize, opt.nz), jnp.float32)
+
+            # (1) D on real, loss_id=0
+            errD_real, gD = vg_d_real(real)
+            with amp.scale_loss(errD_real, optimizerD, loss_id=0):
+                optimizerD.backward(gD)
+            # (1b) D on fake (G detached: only D grads), loss_id=1
+            fake = gen(optimizerG.params, noise)
+            errD_fake, gDf = vg_d_fake(fake)
+            with amp.scale_loss(errD_fake, optimizerD, loss_id=1):
+                optimizerD.backward(gDf)
+            optimizerD.step()
+
+            # (2) G, loss_id=2 (grads w.r.t. G through D)
+            errG, gG = vg_g(optimizerD.params, noise)
+            with amp.scale_loss(errG, optimizerG, loss_id=2):
+                optimizerG.backward(gG)
+            optimizerG.step()
+
+            errD = float(errD_real) + float(errD_fake)
+            print(f"[{epoch}/{opt.niter}][{i}/{opt.iters_per_epoch}] "
+                  f"Loss_D: {errD:.4f} Loss_G: {float(errG):.4f}")
+    dt = time.perf_counter() - t0
+    print(f"done in {dt:.1f}s "
+          f"({opt.niter * opt.iters_per_epoch / dt:.2f} it/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Reference: torch.distributed.launch --nproc_per_node=2 ... (run.sh).
+# TPU-native: SPMD sees every chip in one process — no launcher needed.
+# To simulate a multi-device run on CPU:
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu bash run.sh
+python "$(dirname "$0")/distributed_data_parallel.py"

@@ -197,3 +197,26 @@ def test_initialize_casts_model_o2():
 def test_initialize_o0_stays_fp32():
     params = amp.initialize(_params(), opt_level="O0", verbosity=0)
     assert params["conv1"]["kernel"].dtype == jnp.float32
+
+
+def test_cast_cache_is_identity_checked():
+    """Regression: the weight-cast cache is keyed by id(x); ids are reused
+    after gc, so a hit must verify the stored source IS the argument —
+    otherwise a later array at a recycled address receives a stale cast of
+    a different tensor (observed as shape corruption in the DCGAN
+    multi-model O1 loop)."""
+    from apex_tpu.amp import autocast
+    autocast.clear_cast_cache()
+    x = jnp.ones((3,), jnp.float32)
+    out_x = autocast.cached_cast(jnp.bfloat16, x)
+    assert out_x.dtype == jnp.bfloat16
+    key = (id(x), "bfloat16")
+    assert autocast._cast_cache[key][0] is x   # source pinned
+
+    # Simulate id reuse: plant a stale entry under y's id pointing at x.
+    y = jnp.full((5,), 2.0, jnp.float32)
+    autocast._cast_cache[(id(y), "bfloat16")] = (x, out_x)
+    out_y = autocast.cached_cast(jnp.bfloat16, y)
+    assert out_y.shape == (5,)                 # not the stale (3,) cast
+    np.testing.assert_allclose(np.asarray(out_y, np.float32), 2.0)
+    autocast.clear_cast_cache()

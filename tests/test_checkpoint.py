@@ -1,0 +1,151 @@
+"""Checkpoint/resume tests — the reference's bitwise-resume gate
+(tests/L0/run_amp/test_checkpointing.py:28-300): save mid-training, restore,
+continue, and require IDENTICAL trajectories."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import training
+from apex_tpu.checkpoint import load_checkpoint, save_checkpoint
+from apex_tpu.training import make_train_step
+
+
+def _setup():
+    params = {"dense": {"kernel": jnp.ones((6, 4), jnp.float32) * 0.3,
+                        "bias": jnp.zeros((4,), jnp.float32)}}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        out = x @ p["dense"]["kernel"].astype(x.dtype) + p["dense"]["bias"]
+        return jnp.mean((out.astype(jnp.float32) - y) ** 2)
+
+    tx = training.adam(lr=1e-2)
+    return params, loss_fn, tx
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.randn(8, 6), jnp.float32),
+             jnp.asarray(rng.randn(8, 4), jnp.float32)) for _ in range(n)]
+
+
+def test_bitwise_resume(tmp_path):
+    params, loss_fn, tx = _setup()
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level="O2",
+                                       loss_scale="dynamic",
+                                       keep_batchnorm_fp32=False)
+    step = jax.jit(step_fn)
+    batches = _batches(10)
+
+    # Continuous run.
+    state = init_fn(params)
+    for b in batches:
+        state, _ = step(state, b)
+    final_cont = jax.device_get(state.params)
+
+    # Interrupted run: 5 steps, checkpoint, restore, 5 more.
+    state = init_fn(params)
+    for b in batches[:5]:
+        state, _ = step(state, b)
+    ck = str(tmp_path / "ckpt.npz")
+    save_checkpoint(ck, state, step=5)
+    template = init_fn(params)
+    restored, _, extra = load_checkpoint(ck, template)
+    assert int(extra["step"]) == 5
+    for b in batches[5:]:
+        restored, _ = step(restored, b)
+    final_resumed = jax.device_get(restored.params)
+
+    for a, b in zip(jax.tree_util.tree_leaves(final_cont),
+                    jax.tree_util.tree_leaves(final_resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16_leaves_roundtrip(tmp_path):
+    """Regression: bf16 arrays survive npz (stored as uint16 bits)."""
+    state = {"w": jnp.asarray([[1.5, -2.0]], jnp.bfloat16),
+             "b": jnp.zeros((2,), jnp.float32)}
+    ck = str(tmp_path / "bf16.npz")
+    save_checkpoint(ck, state)
+    restored, _, _ = load_checkpoint(ck, state)
+    assert restored["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"], np.float32),
+        np.asarray(state["w"], np.float32))
+
+
+def test_o3_checkpoint_recoverable(tmp_path):
+    """O3 (bf16 storage) runs must restore from their own checkpoints."""
+    params, loss_fn, tx = _setup()
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level="O3",
+                                       keep_batchnorm_fp32=False)
+    state = init_fn(params)
+    state, _ = jax.jit(step_fn)(state, _batches(1)[0])
+    ck = str(tmp_path / "o3.npz")
+    save_checkpoint(ck, state)
+    restored, _, _ = load_checkpoint(ck, init_fn(params))
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+def test_scaler_state_roundtrips(tmp_path):
+    """Loss-scale decrease must survive a checkpoint (reference
+    test_checkpointing 'restore after scale drop')."""
+    params, loss_fn, tx = _setup()
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level="O2",
+                                       loss_scale="dynamic",
+                                       keep_batchnorm_fp32=False)
+    step = jax.jit(step_fn)
+    state = init_fn(params)
+    bad = (jnp.full((8, 6), jnp.inf, jnp.float32),
+           jnp.zeros((8, 4), jnp.float32))
+    state, m = step(state, bad)
+    assert float(m["loss_scale"]) == 2.0 ** 15
+    ck = str(tmp_path / "scaler.npz")
+    save_checkpoint(ck, state)
+    restored, _, _ = load_checkpoint(ck, init_fn(params))
+    assert float(restored.scaler.loss_scale) == 2.0 ** 15
+    assert int(restored.scaler.unskipped) == int(state.scaler.unskipped)
+
+
+def test_dtype_mismatch_rejected(tmp_path):
+    """Restoring with a different opt_level (different storage dtypes) must
+    fail loudly, mirroring the same-opt-level rule."""
+    params, loss_fn, tx = _setup()
+    init2, _ = make_train_step(loss_fn, tx, opt_level="O2",
+                               keep_batchnorm_fp32=False)
+    init3, _ = make_train_step(loss_fn, tx, opt_level="O3",
+                               keep_batchnorm_fp32=False)
+    ck = str(tmp_path / "o2.npz")
+    save_checkpoint(ck, init2(params))          # fp32 masters
+    with pytest.raises(ValueError, match="opt_level"):
+        load_checkpoint(ck, init3(params))      # bf16 storage template
+
+
+def test_missing_leaf_rejected(tmp_path):
+    params, loss_fn, tx = _setup()
+    init_fn, _ = make_train_step(loss_fn, tx, opt_level="O0")
+    ck = str(tmp_path / "x.npz")
+    save_checkpoint(ck, {"only": jnp.ones((2,))})
+    with pytest.raises(KeyError):
+        load_checkpoint(ck, init_fn(params))
+
+
+def test_amp_state_dict_roundtrip(tmp_path):
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedSGD
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    opt = FusedSGD(params, lr=0.1)
+    params, opt = amp.initialize(params, opt, opt_level="O2",
+                                 loss_scale="dynamic", verbosity=0)
+    sd = amp.state_dict()
+    ck = str(tmp_path / "amp.npz")
+    save_checkpoint(ck, {"dummy": jnp.zeros(())}, amp_state=sd)
+    _, amp_sd, _ = load_checkpoint(ck, {"dummy": jnp.zeros(())})
+    assert any("loss_scale" in k for k in amp_sd)
+    amp.load_state_dict({k: v for k, v in sd.items()})
+    amp.shutdown()
