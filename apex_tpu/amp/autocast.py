@@ -160,36 +160,74 @@ def make_banned_wrapper(orig_fn: Callable, name: str):
 # (module, attribute-name) pairs; resolved lazily at init() so wrapping is
 # reversible and import order does not matter.
 
-import jax.lax as lax  # noqa: E402
-import jax.nn as jnn   # noqa: E402
+import jax.lax as lax       # noqa: E402
+import jax.nn as jnn        # noqa: E402
+import jax.numpy.linalg as jla  # noqa: E402
 
-# MXU ops -> half (reference torch_overrides.py FP16_FUNCS: conv*/BLAS).
+from ..ops import losses as _ops_losses  # noqa: E402
+
+# MXU ops -> half (reference torch_overrides.py FP16_FUNCS: conv*/BLAS
+# mm/matmul/addmm/bmm/... + functional_overrides FP16 conv/linear).
 _HALF_LIST = [
     (jnp, "dot"), (jnp, "matmul"), (jnp, "vdot"), (jnp, "inner"),
-    (jnp, "outer"), (jnp, "tensordot"), (jnp, "einsum"),
+    (jnp, "outer"), (jnp, "tensordot"), (jnp, "einsum"), (jnp, "kron"),
+    (jla, "multi_dot"),
     (lax, "dot"), (lax, "dot_general"),
     (lax, "conv"), (lax, "conv_general_dilated"), (lax, "conv_transpose"),
 ]
 
-# Transcendentals / reductions / norms -> fp32
-# (reference torch_overrides.py FP32_FUNCS + functional_overrides FP32).
+# Transcendentals / reductions / norms / losses -> fp32
+# (reference torch_overrides.py FP32_FUNCS :28-60 — acos/asin/cosh/erf/
+# gamma-family/log*/pow/reductions/norm/renorm — and functional_overrides
+# FP32_FUNCS :22-57 — softmax family, norm layers, losses).
 _FP32_LIST = [
-    (jnp, "exp"), (jnp, "expm1"), (jnp, "log"), (jnp, "log1p"), (jnp, "log2"),
-    (jnp, "log10"), (jnp, "cosh"), (jnp, "sinh"), (jnp, "tan"),
-    (jnp, "power"), (jnp, "float_power"),
+    # transcendentals
+    (jnp, "exp"), (jnp, "exp2"), (jnp, "expm1"), (jnp, "log"), (jnp, "log1p"),
+    (jnp, "log2"), (jnp, "log10"), (jnp, "logaddexp"), (jnp, "logaddexp2"),
+    (jnp, "cosh"), (jnp, "sinh"), (jnp, "tan"),
+    (jnp, "arccos"), (jnp, "arcsin"), (jnp, "arccosh"), (jnp, "arcsinh"),
+    (jnp, "arctanh"),
+    (jnp, "power"), (jnp, "float_power"), (jnp, "reciprocal"),
+    (lax, "erf"), (lax, "erfc"), (lax, "erf_inv"), (lax, "lgamma"),
+    (lax, "digamma"), (lax, "rsqrt"),
+    # reductions
     (jnp, "sum"), (jnp, "prod"), (jnp, "cumsum"), (jnp, "cumprod"),
-    (jnp, "var"), (jnp, "std"), (jnp, "mean"),
+    (jnp, "var"), (jnp, "std"), (jnp, "mean"), (jnp, "median"),
+    (jnp, "trapezoid"),
+    # norms / linalg solvers (reference FP32: cholesky/inverse/norm/...)
+    (jla, "norm"), (jla, "cholesky"), (jla, "inv"), (jla, "pinv"),
+    (jla, "svd"), (jla, "eigh"), (jla, "qr"), (jla, "solve"),
+    (jla, "lstsq"), (jla, "det"), (jla, "slogdet"), (jla, "matrix_power"),
+    (jla, "cond"),
+    # softmax family / exp-based activations (functional_overrides FP32)
     (jnn, "softmax"), (jnn, "log_softmax"), (jnn, "logsumexp"),
-    (jnn, "standardize"),
+    (jnn, "standardize"), (jnn, "softplus"), (jnn, "soft_sign"),
+    (jnn, "sigmoid"), (jnn, "log_sigmoid"), (jnn, "silu"), (jnn, "swish"),
+    (jnn, "gelu"), (jnn, "celu"), (jnn, "elu"), (jnn, "selu"), (jnn, "glu"),
+    # losses (safe logit-space BCE stays fp32-wrapped, never banned).
+    # Both the defining module and the package re-export are patched —
+    # a name bound at import time in ops/__init__ would otherwise bypass
+    # the wrappers.
+    (_ops_losses, "binary_cross_entropy_with_logits"),
 ]
 
-# Sequence promotion (reference SEQUENCE_CASTS = cat/stack).
+# Sequence promotion (reference SEQUENCE_CASTS = cat/stack) + multi-arg ops
+# whose operands must agree (reference CASTS promote list).
 _PROMOTE_LIST = [
     (jnp, "concatenate"), (jnp, "stack"), (jnp, "hstack"), (jnp, "vstack"),
-    (jnp, "where"),
+    (jnp, "dstack"), (jnp, "column_stack"), (jnp, "append"),
+    (jnp, "where"), (jnp, "cross"),
 ]
 
-_BANNED_LIST = []  # populated for fp16 policies via register_banned_function
+# Probability-space BCE needs the full float range: banned under fp16
+# (reference functional_overrides.py:59-70), run in fp32 under bf16.
+_BANNED_LIST = [
+    (_ops_losses, "binary_cross_entropy"),
+]
+
+from .. import ops as _ops_pkg  # noqa: E402  (package re-exports)
+_FP32_LIST.append((_ops_pkg, "binary_cross_entropy_with_logits"))
+_BANNED_LIST.append((_ops_pkg, "binary_cross_entropy"))
 
 _patched = []  # (module, name, original)
 
@@ -206,20 +244,22 @@ def init(enabled=True, verbose=False, allow_banned=False, half_dtype=jnp.bfloat1
         _amp_state.verbosity = 2
     if _patched:
         return
-    for mod, name in _HALF_LIST:
-        orig = getattr(mod, name)
+
+    def _entries(lst):
+        # Tolerate jax-version drift: skip absent entry points.
+        return ((mod, name, getattr(mod, name)) for mod, name in lst
+                if hasattr(mod, name))
+
+    for mod, name, orig in _entries(_HALF_LIST):
         setattr(mod, name, make_cast_wrapper(orig, "half", name))
         _patched.append((mod, name, orig))
-    for mod, name in _FP32_LIST:
-        orig = getattr(mod, name)
+    for mod, name, orig in _entries(_FP32_LIST):
         setattr(mod, name, make_cast_wrapper(orig, jnp.float32, name))
         _patched.append((mod, name, orig))
-    for mod, name in _PROMOTE_LIST:
-        orig = getattr(mod, name)
+    for mod, name, orig in _entries(_PROMOTE_LIST):
         setattr(mod, name, make_promote_wrapper(orig))
         _patched.append((mod, name, orig))
-    for mod, name in _BANNED_LIST:
-        orig = getattr(mod, name)
+    for mod, name, orig in _entries(_BANNED_LIST):
         setattr(mod, name, make_banned_wrapper(orig, name))
         _patched.append((mod, name, orig))
 

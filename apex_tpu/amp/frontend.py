@@ -55,6 +55,10 @@ def initialize(models=None,
     _amp_state.verbosity = verbosity
 
     if not enabled:
+        # Full teardown: restore any patched jnp/lax entry points so a
+        # disabled amp leaves the process pristine (reference
+        # _initialize.py:42-56 returns everything untouched).
+        autocast.shutdown()
         _amp_state.opt_properties = Properties()
         return _unlistify(models, optimizers)
 
@@ -90,6 +94,11 @@ def initialize(models=None,
     model_list = list(models) if models_was_list else ([models] if models is not None else [])
     opt_list = list(optimizers) if optimizers_was_list else ([optimizers] if optimizers is not None else [])
 
+    _check_models(model_list)
+    _check_optimizers(opt_list)
+    if opt_level != "O3":
+        _check_params_fp32(model_list)
+
     for opt in opt_list:
         if getattr(opt, "_amp_wired", False):
             warn_or_err("An optimizer was passed to amp.initialize twice; "
@@ -118,12 +127,65 @@ def initialize(models=None,
         scaler = _amp_state.loss_scalers[min(i, num_losses - 1)]
         if hasattr(opt, "_amp_wire"):
             new_params = model_list[i] if i < len(model_list) else None
-            opt._amp_wire(properties, scaler, cast_params=new_params)
+            opt._amp_wire(properties, scaler, cast_params=new_params,
+                          norm_predicate=norm_predicate)
 
     return _unlistify(model_list if models is not None else None,
                       opt_list if optimizers is not None else None,
                       models_was_list, optimizers_was_list,
                       models is not None, optimizers is not None)
+
+
+def _check_models(model_list):
+    """Reject already-wrapped models (reference ``_initialize.py:60-72``
+    ``check_models``)."""
+    from ..parallel.distributed import DistributedDataParallel as _DDP
+    for model in model_list:
+        if isinstance(model, _DDP):
+            raise RuntimeError(
+                "Incoming model is an instance of "
+                "apex_tpu.parallel.DistributedDataParallel. "
+                "Parallel wrappers should only be applied to the model(s) "
+                "AFTER \nthe model(s) have been returned from "
+                "amp.initialize.")
+
+
+def _check_params_fp32(model_list):
+    """Warn-or-error on reduced-precision incoming params (reference
+    ``_initialize.py:75-112`` ``check_params_fp32``)."""
+    import jax
+
+    for model in model_list:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(model)[0]:
+            if (hasattr(leaf, "dtype")
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and leaf.dtype != jnp.dtype(jnp.float32)):
+                warn_or_err(
+                    "Found param {} with dtype {}, expected float32.\n"
+                    "When using amp.initialize, you do not need to cast "
+                    "your model to\nreduced precision before passing it, no "
+                    "matter what optimization level\nyou choose.".format(
+                        jax.tree_util.keystr(path), leaf.dtype))
+
+
+def _check_optimizers(opt_list):
+    """Reject pre-wrapped FP16 optimizers (reference
+    ``_initialize.py:115-126`` ``check_optimizers``)."""
+    from ..bf16_utils.fp16_optimizer import FP16_Optimizer as _FP16_general
+    from ..optimizers.fp16_optimizer import FP16_Optimizer as _FP16_fused
+    for optim in opt_list:
+        bad_optim_type = None
+        if isinstance(optim, _FP16_general):
+            bad_optim_type = "apex_tpu.bf16_utils.FP16_Optimizer"
+        if isinstance(optim, _FP16_fused):
+            bad_optim_type = "apex_tpu.optimizers.FP16_Optimizer"
+        if bad_optim_type is not None:
+            raise RuntimeError(
+                "An incoming optimizer is an instance of {}. ".format(
+                    bad_optim_type) +
+                "The optimizer(s) passed to amp.initialize() must be bare \n"
+                "instances of apex_tpu fused optimizers (master weights are "
+                "wired in by\namp.initialize itself).\n")
 
 
 def _unlistify(models, optimizers, models_was_list=False, optimizers_was_list=False,

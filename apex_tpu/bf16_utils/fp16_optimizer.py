@@ -53,7 +53,10 @@ class FP16_Optimizer:
             init_optimizer.params)
         # The wrapped optimizer updates the masters.
         self.optimizer.params = self.master_params
-        self.optimizer.state = self.optimizer._init_state(self.master_params)
+        self.optimizer.state = [
+            self.optimizer._init_state(p, g) for p, g in
+            zip(self.optimizer._to_groups(self.master_params),
+                self.optimizer.param_groups)]
         self._master_grads = None
 
     # -- loss / backward ----------------------------------------------------

@@ -92,12 +92,14 @@ def load_checkpoint(path: str, like):
             plain[k] = v
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    consumed = set()
     leaves = []
     for path_elems, leaf in flat:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                        for p in path_elems)
         if key not in plain:
             raise KeyError(f"checkpoint missing leaf {key!r}")
+        consumed.add(key)
         arr = plain[key]
         want_dtype = np.asarray(jax.device_get(leaf)).dtype \
             if hasattr(leaf, "dtype") else None
@@ -107,6 +109,15 @@ def load_checkpoint(path: str, like):
                 f"template {want_dtype} — restore with the same opt_level "
                 f"used at save time (reference checkpointing rule)")
         leaves.append(jax.numpy.asarray(arr))
+    unconsumed = set(plain) - consumed
+    if unconsumed:
+        # A checkpoint from a larger/renamed model would otherwise appear to
+        # load while silently dropping state (ADVICE r1 #5).
+        raise KeyError(
+            "checkpoint holds {} array(s) with no matching template leaf "
+            "(e.g. {!r}) — the template pytree does not match the model "
+            "that was saved".format(len(unconsumed),
+                                    sorted(unconsumed)[0]))
     state = jax.tree_util.tree_unflatten(
         treedef, leaves)
     return state, amp_state, extra

@@ -60,6 +60,23 @@ def _bwd_ref(g, logits, mlse, labels, smoothing):
 # -- pallas kernels -----------------------------------------------------------
 
 _ROW_BLOCK = 128
+_VMEM_BUFFER_BUDGET = 2 * 1024 * 1024   # bytes per fp32 [R, H] working buffer
+
+
+def _row_block(n, h):
+    """Rows per grid step, sized so the fp32 [R, H] working buffers stay
+    inside the TPU's ~16MB scoped-VMEM limit even for LM-head-sized
+    vocabularies (e.g. H=30522).  The backward kernel holds up to ~6 live
+    [R, H] intermediates (logits, softmax, onehot/iota, grad-out), hence the
+    conservative per-buffer budget."""
+    rows = min(_ROW_BLOCK, _VMEM_BUFFER_BUDGET // (4 * h))
+    rows = max(8, (rows // 8) * 8)      # sublane multiple
+    return min(rows, max(8, n))
+
+
+def _pallas_fits(h):
+    """Even the minimum 8-row block must fit the scoped-VMEM budget."""
+    return 8 * h * 4 <= 2 * _VMEM_BUFFER_BUDGET
 
 
 # Per-row vectors (labels, losses, mlse, incoming grads) travel as [R, 1]
@@ -95,7 +112,7 @@ def _bwd_kernel(g_ref, x_ref, mlse_ref, lab_ref, dx_ref, *, smoothing):
 
 def _fwd_pallas(logits, labels, smoothing):
     n, h = logits.shape
-    blk = min(_ROW_BLOCK, n)
+    blk = _row_block(n, h)
     grid = (n + blk - 1) // blk
     loss, mlse = pl.pallas_call(
         functools.partial(_fwd_kernel, smoothing=smoothing),
@@ -112,7 +129,7 @@ def _fwd_pallas(logits, labels, smoothing):
 
 def _bwd_pallas(g, logits, mlse, labels, smoothing):
     n, h = logits.shape
-    blk = min(_ROW_BLOCK, n)
+    blk = _row_block(n, h)
     grid = (n + blk - 1) // blk
     return pl.pallas_call(
         functools.partial(_bwd_kernel, smoothing=smoothing),
@@ -143,7 +160,7 @@ def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
 
 def _fwd_impl(logits, labels, smoothing):
     labels = labels.astype(jnp.int32)
-    if _use_pallas():
+    if _use_pallas() and _pallas_fits(logits.shape[-1]):
         return _fwd_pallas(logits, labels, smoothing)
     return _fwd_ref(logits, labels, smoothing)
 
@@ -159,7 +176,7 @@ def _bwd_vjp(smoothing, padding_idx, half_to_float, res, g):
     logits, mlse, labels = res
     g = jnp.where(labels == padding_idx, 0.0,
                   g.astype(jnp.float32))
-    if _use_pallas():
+    if _use_pallas() and _pallas_fits(logits.shape[-1]):
         dx = _bwd_pallas(g, logits, mlse, labels, smoothing)
     else:
         dx = _bwd_ref(g, logits, mlse, labels, smoothing)

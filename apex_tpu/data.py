@@ -101,11 +101,16 @@ class PrefetchLoader:
 
 
 def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
-                       shuffle: bool = True, seed: int = 0):
+                       shuffle: bool = True, seed: int = 0,
+                       drop_last: bool = True):
     """Stream (uint8 NHWC batch, labels) from an ImageNet-style directory:
     ``root/<class_name>/*.{npy,jpg,jpeg,png}``.  ``.npy`` files must hold
     HWC uint8; image files decode via PIL when available.  The heavy
-    epilogue (normalize) stays in :func:`normalize_images` (native C++)."""
+    epilogue (normalize) stays in :func:`normalize_images` (native C++).
+
+    ``drop_last=True`` (default) discards a trailing partial batch — the
+    static-shape-friendly choice for jit'd train steps; pass
+    ``drop_last=False`` to also yield the final short batch."""
     import os
 
     classes = sorted(d for d in os.listdir(root)
@@ -138,7 +143,8 @@ def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
             img = img[ys][:, xs]
         return img.astype(np.uint8)
 
-    for i in range(0, len(samples) - batch_size + 1, batch_size):
+    stop = (len(samples) - batch_size + 1) if drop_last else len(samples)
+    for i in range(0, stop, batch_size):
         batch = samples[i:i + batch_size]
         imgs = np.stack([load(p) for p, _ in batch])
         labels = np.asarray([l for _, l in batch], np.int32)

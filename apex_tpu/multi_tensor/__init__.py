@@ -29,7 +29,8 @@ import jax.numpy as jnp
 
 __all__ = [
     "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm",
-    "multi_tensor_maxnorm", "tree_finite", "MultiTensorApply",
+    "multi_tensor_maxnorm", "multi_tensor_lamb_stage1",
+    "multi_tensor_lamb_stage2", "tree_finite", "MultiTensorApply",
     "multi_tensor_applier", "flatten", "unflatten",
 ]
 
@@ -116,6 +117,71 @@ def multi_tensor_maxnorm(tree, per_tensor: bool = False):
     if per_tensor:
         return total, m
     return total
+
+
+# -- legacy two-stage LAMB entry points ---------------------------------------
+
+def multi_tensor_lamb_stage1(grads, params, exp_avg, exp_avg_sq,
+                             per_tensor_decay, *, beta1, beta2,
+                             beta1_correction, beta2_correction,
+                             epsilon, clipped_global_grad_norm):
+    """Stage 1 of the legacy two-stage LAMB decomposition.
+
+    Equivalent of ``amp_C.multi_tensor_lamb_stage1_cuda``
+    (``csrc/multi_tensor_lamb_stage_1.cu``): per leaf,
+    ``scaled_g = g / clipped_global_grad_norm``, Adam moment EMAs, and
+    ``update = m_hat / (sqrt(v_hat) + eps) + decay * p`` with an explicit
+    per-tensor decay array (flattened-leaf order).
+
+    Returns ``(updates, new_exp_avg, new_exp_avg_sq)`` as pytrees.
+    """
+    leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+    leaves_p = jax.tree_util.tree_leaves(params)
+    leaves_m = jax.tree_util.tree_leaves(exp_avg)
+    leaves_v = jax.tree_util.tree_leaves(exp_avg_sq)
+    if len(per_tensor_decay) != len(leaves_g):
+        raise ValueError("per_tensor_decay must have one entry per leaf "
+                         f"({len(per_tensor_decay)} != {len(leaves_g)})")
+    upd, new_m, new_v = [], [], []
+    for g, p, m, v, decay in zip(leaves_g, leaves_p, leaves_m, leaves_v,
+                                 per_tensor_decay):
+        sg = jnp.asarray(g, jnp.float32) / clipped_global_grad_norm
+        m_n = beta1 * jnp.asarray(m, jnp.float32) + (1.0 - beta1) * sg
+        v_n = (beta2 * jnp.asarray(v, jnp.float32)
+               + (1.0 - beta2) * jnp.square(sg))
+        m_hat = m_n / beta1_correction
+        v_hat = v_n / beta2_correction
+        u = m_hat / (jnp.sqrt(v_hat) + epsilon) \
+            + decay * jnp.asarray(p, jnp.float32)
+        upd.append(u)
+        new_m.append(m_n)
+        new_v.append(v_n)
+    return (treedef.unflatten(upd), treedef.unflatten(new_m),
+            treedef.unflatten(new_v))
+
+
+def multi_tensor_lamb_stage2(params, updates, per_tensor_param_norm,
+                             per_tensor_update_norm, learning_rate):
+    """Stage 2 of the legacy two-stage LAMB decomposition.
+
+    Equivalent of ``amp_C.multi_tensor_lamb_stage2_cuda``
+    (``csrc/multi_tensor_lamb_stage_2.cu``):
+    ``ratio = lr * (p_norm / u_norm)`` when both norms are nonzero, plain
+    ``lr`` otherwise; ``p -= ratio * update``.  Norm arrays are in
+    flattened-leaf order (use ``multi_tensor_l2norm(..., per_tensor=True)``).
+    """
+    leaves_p, treedef = jax.tree_util.tree_flatten(params)
+    leaves_u = jax.tree_util.tree_leaves(updates)
+    new_p = []
+    for p, u, pn, un in zip(leaves_p, leaves_u, per_tensor_param_norm,
+                            per_tensor_update_norm):
+        pn = jnp.asarray(pn, jnp.float32)
+        un = jnp.asarray(un, jnp.float32)
+        ratio = jnp.where((pn != 0.0) & (un != 0.0),
+                          learning_rate * (pn / un), learning_rate)
+        p32 = jnp.asarray(p, jnp.float32) - ratio * jnp.asarray(u, jnp.float32)
+        new_p.append(p32.astype(jnp.asarray(p).dtype))
+    return treedef.unflatten(new_p)
 
 
 # -- flatten / unflatten ------------------------------------------------------

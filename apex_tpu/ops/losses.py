@@ -1,0 +1,56 @@
+"""Loss functions with reference-parity banned-function semantics.
+
+The reference bans probability-space ``binary_cross_entropy`` under fp16
+autocast because ``log(p)`` needs the full float range
+(``apex/amp/lists/functional_overrides.py:59-70``); the safe
+``binary_cross_entropy_with_logits`` replacement stays allowed.  The jnp
+namespace has no probability-space BCE, so this module provides both: the
+unsafe one is registered on the default fp16 banned list (see
+``amp.autocast``) and raises the reference's error under an fp16 policy;
+under the bf16 default it runs in fp32 instead.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def binary_cross_entropy(probs, targets, weight=None, reduction="mean"):
+    """Probability-space BCE: ``-[t*log(p) + (1-t)*log(1-p)]``.
+
+    Numerically fragile in half precision (reference bans it under fp16
+    autocast); prefer :func:`binary_cross_entropy_with_logits`.
+    """
+    p = jnp.asarray(probs)
+    t = jnp.asarray(targets, p.dtype)
+    eps = jnp.finfo(p.dtype).tiny
+    loss = -(t * jnp.log(p + eps) + (1.0 - t) * jnp.log(1.0 - p + eps))
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def binary_cross_entropy_with_logits(logits, targets, weight=None,
+                                     pos_weight=None, reduction="mean"):
+    """Logit-space BCE via the stable log-sum-exp form (the reference's safe
+    replacement, always autocast-compatible)."""
+    x = jnp.asarray(logits, jnp.float32)
+    t = jnp.asarray(targets, jnp.float32)
+    neg_abs = -jnp.abs(x)
+    softplus = jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_w = 1.0 + (pos_weight - 1.0) * t
+        loss = (1.0 - t) * x + log_w * (softplus + jnp.maximum(-x, 0.0))
+    else:
+        loss = jnp.maximum(x, 0.0) - x * t + softplus
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

@@ -3,8 +3,9 @@
 The idiomatic JAX path is the functional one (``apex_tpu.optimizers.
 functional`` / the optax-style transforms in ``transforms.py``); this class
 provides the reference's imperative surface (``opt.step()``,
-``opt.zero_grad()``, ``state_dict``) plus the amp handshake that reference
-``apex/amp/_process_optimizer.py`` injects with ``types.MethodType``:
+``opt.zero_grad()``, ``state_dict``, multiple ``param_groups``) plus the amp
+handshake that reference ``apex/amp/_process_optimizer.py`` injects with
+``types.MethodType``:
 
 * ``_amp_wire`` — master-weight setup (fp32 masters when the model params are
   reduced precision; reference ``:28-90``).
@@ -15,15 +16,24 @@ provides the reference's imperative surface (``opt.step()``,
   (reference ``handle.py:126-151`` patches ``step``; the latch restores
   itself after one ``step`` call exactly like the patched function).
 
-The actual parameter update is ONE jitted XLA program per optimizer (the
-multi-tensor capability); hyperparameters that may change between steps (lr)
-are passed as traced scalars so no recompilation occurs.
+Parameter groups (reference ``apex/optimizers/fused_adam.py:75-134`` iterates
+``param_groups`` with per-group lr/wd/betas): construct with either a params
+pytree (one implicit group) or a list of dicts ``[{"params": subtree,
+"lr": ..., "weight_decay": ...}, ...]``; per-group hyperparameters override
+the defaults.  ``self.params`` (and the grads you pass to ``step``/
+``backward``) then has the structure ``[group0_params, group1_params, ...]``.
+
+The actual parameter update is still ONE jitted XLA program per optimizer
+(the multi-tensor capability) — the per-group loop happens at trace time.
+Learning rates are passed as traced scalars so lr changes never recompile;
+other group hyperparameters are compile-time constants (mutating them
+triggers one retrace on the next step, matching the rare-change pattern).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,16 +42,26 @@ from ..amp import policy as _policy
 from ..amp._amp_state import maybe_print
 
 
+def _is_group_list(params) -> bool:
+    return (isinstance(params, (list, tuple)) and len(params) > 0
+            and all(isinstance(g, dict) and "params" in g for g in params))
+
+
 class FusedOptimizer:
-    """Base: subclasses define ``_init_state(params)`` and ``_update`` (a pure
-    function ``(grads, state, params, lr, grad_scale, apply_mask) ->
-    (params, state)``)."""
+    """Base: subclasses define ``_init_state(params, group)`` and ``_update``
+    (a pure function ``(grads, state, params, group, lr, grad_scale,
+    apply_mask) -> (params, state)`` reading static hyperparameters from
+    ``group``)."""
 
     def __init__(self, params, defaults: Dict[str, Any]):
         self.defaults = dict(defaults)
-        self.params = params
-        self.master_params = None          # fp32 masters when amp O2-wired
-        self.state = self._init_state(params)
+        self._grouped = _is_group_list(params)
+        raw_groups = list(params) if self._grouped else [{"params": params}]
+        self.param_groups: List[Dict[str, Any]] = [
+            dict(self.defaults, **g) for g in raw_groups]
+        self._masters = None           # list of fp32 masters when amp-wired
+        self.state = [self._init_state(g["params"], g)
+                      for g in self.param_groups]
         self.loss_scaler = None
         self.properties = None
         self._amp_wired = False
@@ -49,21 +69,117 @@ class FusedOptimizer:
         self._pending_grads = None         # scaled, model-dtype grads
         self._stashed_grads = None         # for grad accumulation
         self._master_grads = None          # unscaled fp32 grads, step() input
-        self._jit_update = jax.jit(self._update_with_config)
-        # param_groups parity: one group holding the whole tree; lr is
-        # mutable between steps without recompilation.
-        self.param_groups = [dict(self.defaults, params=self.params)]
+        self._jit_update = None
+        self._jit_key = None
+
+    # -- group plumbing -----------------------------------------------------
+    def _to_groups(self, tree):
+        """User-facing structure -> canonical per-group list."""
+        return list(tree) if self._grouped else [tree]
+
+    def _from_groups(self, lst):
+        """Canonical per-group list -> user-facing structure."""
+        return list(lst) if self._grouped else lst[0]
+
+    @property
+    def params(self):
+        """User-facing params: the original pytree for an implicit single
+        group, ``[group0_params, ...]`` for grouped construction."""
+        return self._from_groups([g["params"] for g in self.param_groups])
+
+    @params.setter
+    def params(self, value):
+        self._set_group_params(self._to_groups(value))
+
+    def _set_group_params(self, groups_list):
+        for g, p in zip(self.param_groups, groups_list):
+            g["params"] = p
+
+    @property
+    def master_params(self):
+        """fp32 masters in the user-facing structure (None unless
+        amp-wired with master weights)."""
+        return None if self._masters is None else self._from_groups(self._masters)
+
+    @master_params.setter
+    def master_params(self, value):
+        self._masters = None if value is None else self._to_groups(value)
+
+    def _group_lrs(self):
+        return [jnp.float32(g.get("lr", self.defaults.get("lr", 0.0)))
+                for g in self.param_groups]
+
+    def _static_key(self):
+        def freeze(v):
+            if isinstance(v, list):
+                return tuple(v)
+            return v
+        return tuple(
+            tuple(sorted((k, freeze(v)) for k, v in g.items()
+                         if k not in ("params", "lr")))
+            for g in self.param_groups)
+
+    def _run_update(self, grads_groups, targets_groups, grad_scale):
+        """The single jitted whole-model update over all groups.  Rebuilds
+        the jitted function only when static group hyperparameters change."""
+        key = self._static_key()
+        if self._jit_update is None or key != self._jit_key:
+            hparams = [{k: v for k, v in g.items() if k != "params"}
+                       for g in self.param_groups]
+
+            def update_all(grads, states, params, lrs, scale):
+                new_p, new_s = [], []
+                for g, s, p, h, lr in zip(grads, states, params, hparams,
+                                          lrs):
+                    np_, ns = self._update(g, s, p, group=h, lr=lr,
+                                           grad_scale=scale, apply_mask=None)
+                    new_p.append(np_)
+                    new_s.append(ns)
+                return new_p, new_s
+
+            self._jit_update = jax.jit(update_all)
+            self._jit_key = key
+        return self._jit_update(grads_groups, self.state, targets_groups,
+                                self._group_lrs(), grad_scale)
+
+    def add_param_group(self, group: Dict[str, Any]):
+        """Reference ``add_param_group`` patch (``_process_optimizer.py:
+        403-479``): appends a group (with master creation when amp-wired)."""
+        if not isinstance(group, dict) or "params" not in group:
+            raise ValueError("param group must be a dict with a 'params' key")
+        if not self._grouped and len(self.param_groups) == 1:
+            # Promote to grouped mode: params/grads structures become lists.
+            self._grouped = True
+        g = dict(self.defaults, **group)
+        if self._amp_wired and self.properties is not None:
+            # Cast the appended group's params to the model dtype first,
+            # like the reference's add_param_group patch
+            # (_process_optimizer.py:403-479) — otherwise the new group
+            # would silently stay fp32 while the rest runs bf16.
+            cast_type = self.properties.cast_model_type
+            if (cast_type is not None
+                    and jnp.dtype(cast_type) != jnp.dtype(jnp.float32)):
+                keep_bn = self.properties.keep_batchnorm_fp32
+                keep_bn = True if keep_bn is None else keep_bn
+                g["params"] = _policy.convert_params(
+                    g["params"], cast_type, keep_norm_fp32=keep_bn,
+                    norm_predicate=getattr(self, "_norm_predicate", None))
+        self.param_groups.append(g)
+        if self._masters is not None:
+            master = _policy.make_master(g["params"])
+            self._masters = list(self._masters) + [master]
+            self.state.append(self._init_state(master, g))
+        else:
+            self.state.append(self._init_state(g["params"], g))
+        self._jit_update = None        # group count changed: retrace
 
     # -- subclass hooks -----------------------------------------------------
-    def _init_state(self, params):
+    def _init_state(self, params, group=None):
         raise NotImplementedError
 
-    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
+    def _update(self, grads, state, params, *, group, lr, grad_scale,
+                apply_mask):
         raise NotImplementedError
-
-    def _update_with_config(self, grads, state, params, lr, grad_scale):
-        return self._update(grads, state, params, lr=lr,
-                            grad_scale=grad_scale, apply_mask=None)
 
     # -- main API -----------------------------------------------------------
     @property
@@ -72,7 +188,8 @@ class FusedOptimizer:
 
     @lr.setter
     def lr(self, value):
-        self.param_groups[0]["lr"] = value
+        for g in self.param_groups:
+            g["lr"] = value
 
     def value_and_grad(self, loss_fn: Callable, has_aux: bool = False):
         """Return ``fn(*args) -> (loss, grads)`` differentiating the *scaled*
@@ -102,21 +219,47 @@ class FusedOptimizer:
                 jnp.add, self._pending_grads, grads)
 
     # -- amp handshake ------------------------------------------------------
-    def _amp_wire(self, properties, loss_scaler, cast_params=None):
+    def _amp_wire(self, properties, loss_scaler, cast_params=None,
+                  norm_predicate=None):
         self.properties = properties
         self.loss_scaler = loss_scaler
         self._amp_wired = True
-        if cast_params is not None:
-            model_params = cast_params
+        self._norm_predicate = norm_predicate
+        if self._grouped:
+            # A grouped optimizer owns subtrees of the model; the i-th model
+            # pytree passed by amp.initialize does NOT match the group
+            # structure (reference groups are views of the same tensors, so
+            # casting the model suffices there).  Cast each group's own
+            # params with the same policy instead.
+            if (isinstance(cast_params, (list, tuple))
+                    and len(cast_params) == len(self.param_groups)):
+                model_groups = list(cast_params)
+            else:
+                cast_type = properties.cast_model_type
+                if (cast_type is not None and
+                        jnp.dtype(cast_type) != jnp.dtype(jnp.float32)):
+                    keep_bn = properties.keep_batchnorm_fp32
+                    keep_bn = True if keep_bn is None else keep_bn
+                    model_groups = [
+                        _policy.convert_params(g["params"], cast_type,
+                                               keep_norm_fp32=keep_bn,
+                                               norm_predicate=norm_predicate)
+                        for g in self.param_groups]
+                else:
+                    model_groups = [g["params"] for g in self.param_groups]
         else:
-            model_params = self.params
+            model_params = (cast_params if cast_params is not None
+                            else self.params)
+            model_groups = self._to_groups(model_params)
         if properties.master_weights:
             # fp32 masters are the update target (reference
             # _process_optimizer.py:28-90: masters swapped into param_groups).
-            self.master_params = _policy.make_master(model_params)
-            self.state = self._init_state(self.master_params)
-        self.params = model_params
-        self.param_groups[0]["params"] = self.params
+            self._masters = [_policy.make_master(mp)
+                             for mp in model_groups]
+            self.state = [self._init_state(mp, g) for mp, g in
+                          zip(self._masters, self.param_groups)]
+            self._jit_update = None
+        self._set_group_params(model_groups)
 
     def _prepare_amp_backward(self):
         """Reference ``_prepare_amp_backward`` (:134-150): stash existing
@@ -145,7 +288,8 @@ class FusedOptimizer:
     # -- step ---------------------------------------------------------------
     def step(self, grads=None, closure=None):
         """Apply one update.  ``grads`` defaults to the amp-delivered master
-        grads; without amp pass (unscaled) grads directly."""
+        grads; without amp pass (unscaled) grads directly.  With multiple
+        param groups the grads structure is ``[grads_group0, ...]``."""
         if closure is not None:
             closure()
         if self._skip_next_step:
@@ -166,18 +310,19 @@ class FusedOptimizer:
             raise ValueError("step() called with no gradients; pass grads or "
                              "use backward()/amp.scale_loss first.")
 
-        target = self.master_params if self.master_params is not None else self.params
-        lr = jnp.float32(self.param_groups[0].get("lr", self.defaults.get("lr", 0.0)))
-        new_params, self.state = self._jit_update(
-            grads, self.state, target, lr, jnp.float32(1.0))
+        targets = (self._masters if self._masters is not None
+                   else [g["params"] for g in self.param_groups])
+        new_params, self.state = self._run_update(
+            self._to_groups(grads), targets, jnp.float32(1.0))
 
-        if self.master_params is not None:
-            self.master_params = new_params
+        if self._masters is not None:
+            self._masters = new_params
             # master -> model copy (reference _process_optimizer.py:345-356).
-            self.params = _policy.master_to_model(new_params, self.params)
+            model = [_policy.master_to_model(mp, g["params"]) for mp, g in
+                     zip(new_params, self.param_groups)]
+            self._set_group_params(model)
         else:
-            self.params = new_params
-        self.param_groups[0]["params"] = self.params
+            self._set_group_params(new_params)
         self._master_grads = None
         self._pending_grads = None
         return self.params
@@ -194,17 +339,30 @@ class FusedOptimizer:
         sd = {
             "state": jax.device_get(self.state),
             "defaults": dict(self.defaults),
-            "lr": self.param_groups[0].get("lr", self.defaults.get("lr")),
+            "lr": [g.get("lr", self.defaults.get("lr"))
+                   for g in self.param_groups],
         }
-        if self.master_params is not None:
-            sd["master_params"] = jax.device_get(self.master_params)
+        if self._masters is not None:
+            sd["master_params"] = jax.device_get(self._masters)
         return sd
 
     def load_state_dict(self, sd):
-        self.state = jax.tree_util.tree_map(jnp.asarray, sd["state"])
-        if "lr" in sd and sd["lr"] is not None:
-            self.param_groups[0]["lr"] = sd["lr"]
+        state = sd["state"]
+        if not isinstance(state, list):       # single-group legacy format
+            state = [state]
+        self.state = [jax.tree_util.tree_map(jnp.asarray, s) for s in state]
+        lrs = sd.get("lr")
+        if lrs is not None:
+            if not isinstance(lrs, list):
+                lrs = [lrs]
+            for g, lr in zip(self.param_groups, lrs):
+                g["lr"] = lr
         if sd.get("master_params") is not None:
-            self.master_params = jax.tree_util.tree_map(
-                jnp.asarray, sd["master_params"])
-            self.params = _policy.master_to_model(self.master_params, self.params)
+            masters = sd["master_params"]
+            if not isinstance(masters, list):
+                masters = [masters]
+            self._masters = [jax.tree_util.tree_map(jnp.asarray, m)
+                             for m in masters]
+            model = [_policy.master_to_model(mp, g["params"]) for mp, g in
+                     zip(self._masters, self.param_groups)]
+            self._set_group_params(model)

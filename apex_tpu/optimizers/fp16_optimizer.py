@@ -30,7 +30,10 @@ class FP16_Optimizer:
         self.fp16_params = init_optimizer.params
         self.fp32_masters = _policy.make_master(self.fp16_params)
         init_optimizer.params = self.fp32_masters
-        init_optimizer.state = init_optimizer._init_state(self.fp32_masters)
+        init_optimizer.state = [
+            init_optimizer._init_state(p, g) for p, g in
+            zip(init_optimizer._to_groups(self.fp32_masters),
+                init_optimizer.param_groups)]
 
         if dynamic_loss_scale:
             args = dynamic_loss_args or {}
@@ -85,9 +88,19 @@ class FP16_Optimizer:
         if grads is None:
             raise ValueError("step() before backward()/update_master_grads()")
         norm = jax.device_get(self._compute_grad_norm(grads))
-        self.overflow = bool(norm == -1.0)
-        should_skip = self.loss_scaler.update_scale_sync() if self.loss_scaler.dynamic else self.overflow
-        # Dynamic scaler tracks overflow via unscale; static path uses norm.
+        norm_overflow = bool(norm == -1.0)
+        # Skip coherence (reference fp16_optimizer.py:176-194): the step is
+        # gated on the scaler's recorded overflow AND the norm check, and the
+        # dynamic scale update sees the combined decision — an overflow found
+        # by either mechanism both skips the step and backs the scale off.
+        if self.loss_scaler.dynamic:
+            if norm_overflow:
+                self.loss_scaler.state = self.loss_scaler.state._replace(
+                    overflow=jnp.asarray(True))
+            should_skip = self.loss_scaler.update_scale_sync()
+        else:
+            should_skip = norm_overflow
+        self.overflow = should_skip or norm_overflow
         if self.overflow:
             print("OVERFLOW! Skipping step. Reducing loss scale to {}".format(
                 self.loss_scaler.loss_scale()))

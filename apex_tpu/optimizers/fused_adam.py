@@ -22,11 +22,12 @@ class FusedAdam(FusedOptimizer):
                         adam_w_mode=adam_w_mode)
         super().__init__(params, defaults)
 
-    def _init_state(self, params):
+    def _init_state(self, params, group=None):
         return F.adam_init(params)
 
-    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
-        d = self.defaults
+    def _update(self, grads, state, params, *, group, lr, grad_scale,
+                apply_mask):
+        d = group
         return F.adam_update(
             grads, state, params, lr=lr,
             beta1=d["betas"][0], beta2=d["betas"][1], eps=d["eps"],

@@ -29,11 +29,12 @@ class FusedNovoGrad(FusedOptimizer):
                         reg_inside_moment=reg_inside_moment)
         super().__init__(params, defaults)
 
-    def _init_state(self, params):
+    def _init_state(self, params, group=None):
         return F.novograd_init(params)
 
-    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
-        d = self.defaults
+    def _update(self, grads, state, params, *, group, lr, grad_scale,
+                apply_mask):
+        d = group
         return F.novograd_update(
             grads, state, params, lr=lr,
             beta1=d["betas"][0], beta2=d["betas"][1], eps=d["eps"],

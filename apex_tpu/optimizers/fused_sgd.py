@@ -36,11 +36,13 @@ class FusedSGD(FusedOptimizer):
         self.scale_set_by_backward = False
         super().__init__(params, defaults)
 
-    def _init_state(self, params):
-        return F.sgd_init(params, self.defaults["momentum"])
+    def _init_state(self, params, group=None):
+        momentum = (group or self.defaults)["momentum"]
+        return F.sgd_init(params, momentum)
 
-    def _update(self, grads, state, params, *, lr, grad_scale, apply_mask):
-        d = self.defaults
+    def _update(self, grads, state, params, *, group, lr, grad_scale,
+                apply_mask):
+        d = group
         return F.sgd_update(
             grads, state, params, lr=lr, momentum=d["momentum"],
             dampening=d["dampening"], nesterov=d["nesterov"],
@@ -79,13 +81,13 @@ class FusedSGD(FusedOptimizer):
                 and self._master_grads is not None and not self._skip_next_step):
             if closure is not None:
                 closure()
-            lr = jnp.float32(self.param_groups[0].get("lr", self.defaults["lr"]))
             scale = jnp.float32(self.most_recent_scale)
-            new_params, self.state = self._jit_update(
-                self._master_grads, self.state, self.master_params, lr, scale)
-            self.master_params = new_params
-            self.params = _policy.master_to_model(new_params, self.params)
-            self.param_groups[0]["params"] = self.params
+            new_params, self.state = self._run_update(
+                self._to_groups(self._master_grads), self._masters, scale)
+            self._masters = new_params
+            model = [_policy.master_to_model(mp, g["params"]) for mp, g in
+                     zip(new_params, self.param_groups)]
+            self._set_group_params(model)
             self._master_grads = None
             self.most_recent_scale = 1.0
             self.scale_set_by_backward = False

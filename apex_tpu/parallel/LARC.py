@@ -62,23 +62,32 @@ class LARC:
     def step(self, grads=None, closure=None):
         if grads is None:
             grads = self.optim._master_grads or self.optim._pending_grads
-        wd = self.optim.defaults.get("weight_decay", 0.0)
-        lr = self.optim.param_groups[0].get("lr",
-                                            self.optim.defaults.get("lr"))
-        target = (self.optim.master_params
-                  if self.optim.master_params is not None
-                  else self.optim.params)
-        new_grads = larc_gradients(grads, target, lr=lr,
-                                   trust_coefficient=self.trust_coefficient,
-                                   clip=self.clip, eps=self.eps,
-                                   weight_decay=wd)
+        targets = (self.optim._masters
+                   if self.optim._masters is not None
+                   else [g["params"] for g in self.optim.param_groups])
+        # Per-group rewrite with the group's own lr and weight decay
+        # (reference absorbs/restores wd per group, LARC.py:71-97).
+        new_groups = []
+        for gr, tgt, g in zip(self.optim._to_groups(grads), targets,
+                              self.optim.param_groups):
+            wd = g.get("weight_decay", 0.0)
+            lr = g.get("lr", self.optim.defaults.get("lr"))
+            new_groups.append(larc_gradients(
+                gr, tgt, lr=lr, trust_coefficient=self.trust_coefficient,
+                clip=self.clip, eps=self.eps, weight_decay=wd))
+        new_grads = self.optim._from_groups(new_groups)
         # Absorb wd: temporarily zero it in the inner update (reference :42-97).
-        saved = self.optim.defaults.get("weight_decay", 0.0)
+        saved = [g.get("weight_decay", 0.0) for g in self.optim.param_groups]
+        saved_default = self.optim.defaults.get("weight_decay", 0.0)
+        for g in self.optim.param_groups:
+            g["weight_decay"] = 0.0
         self.optim.defaults["weight_decay"] = 0.0
         try:
             return self.optim.step(grads=new_grads, closure=closure)
         finally:
-            self.optim.defaults["weight_decay"] = saved
+            self.optim.defaults["weight_decay"] = saved_default
+            for g, wd in zip(self.optim.param_groups, saved):
+                g["weight_decay"] = wd
 
     def state_dict(self):
         return self.optim.state_dict()

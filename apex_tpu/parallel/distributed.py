@@ -45,18 +45,54 @@ def group_psum(x, axis_name: str, axis_index_groups=None):
     """``psum`` over ``axis_name``, optionally restricted to rank sub-groups.
 
     Sub-grouped all-reduce is the HLO ``replica_groups`` feature (reference
-    process groups, SURVEY.md §5).  ``shard_map`` does not accept
-    ``axis_index_groups`` on ``psum``, so groups lower to
-    ``all_gather`` + a static membership mask contraction — a single
-    collective plus an on-chip reduction, numerically identical to the
-    grouped all-reduce.
+    process groups, SURVEY.md §5).  Lowering strategy, most to least
+    scalable:
+
+    1. native ``psum(axis_index_groups=...)`` where the trace allows it
+       (pmap; shard_map raises NotImplementedError as of this jax version);
+    2. butterfly (recursive-doubling) all-reduce over ``ppermute`` when all
+       groups share a power-of-two size — O(|tensor|) memory, log2(k)
+       collectives riding ICI, and a rank-invariant reduction tree (bitwise
+       identical results on every member, like a real grouped all-reduce);
+    3. fallback for irregular groups: ``all_gather`` + a static membership
+       mask contraction (O(world x |tensor|) — fine on test meshes, not for
+       pods; numerically fp32-accumulated).
     """
     if axis_index_groups is None:
         return lax.psum(x, axis_name)
+    groups = [list(g) for g in axis_index_groups]
+    try:
+        return lax.psum(x, axis_name, axis_index_groups=groups)
+    except NotImplementedError:
+        pass
+    sizes = {len(g) for g in groups}
+    if len(sizes) == 1:
+        k = sizes.pop()
+        if k > 0 and (k & (k - 1)) == 0:
+            return _group_psum_butterfly(x, axis_name, groups, k)
+    return _group_psum_gather_mask(x, axis_name, groups)
+
+
+def _group_psum_butterfly(x, axis_name: str, groups, k: int):
+    """Grouped all-reduce as log2(k) XOR-partner exchange-and-add rounds.
+
+    Every member of a group applies the SAME pairwise summation tree, so all
+    members finish with bitwise-identical sums (commutativity of IEEE
+    addition), matching the determinism contract of an HLO grouped
+    all-reduce."""
+    step = 1
+    while step < k:
+        perm = [(g[m ^ step], g[m]) for g in groups for m in range(k)]
+        x = x + lax.ppermute(x, axis_name, perm)
+        step <<= 1
+    return x
+
+
+def _group_psum_gather_mask(x, axis_name: str, groups):
     world = lax.axis_size(axis_name)
     import numpy as _np
     member = _np.zeros((world, world), _np.float32)
-    for g in axis_index_groups:
+    for g in groups:
         for i in g:
             for j in g:
                 member[i, j] = 1.0
@@ -143,9 +179,15 @@ def reduce_gradients(grads,
         if not need:
             # Fully pre-summed by the implicit psum — which spans the FULL
             # axes (subgroup structure is invisible to the transpose), so
-            # average over the full product regardless of axis_index_groups.
+            # average over the full product regardless of axis_index_groups —
+            # unless the caller passed world_size, which always wins (same
+            # contract as the explicit branch below).  With
+            # gradient_average=False the explicit branch's predivide/
+            # postmultiply cancel to a plain sum, which is what the implicit
+            # psum already produced, so the raw sum is returned either way.
             if gradient_average:
-                return (g / full_world).astype(jnp.asarray(g).dtype)
+                denom = world_size if explicit_world else full_world
+                return (g / denom).astype(jnp.asarray(g).dtype)
             return g
         orig_dtype = jnp.asarray(g).dtype
         if allreduce_always_fp32:

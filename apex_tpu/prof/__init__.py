@@ -4,11 +4,18 @@ Three stages, mapped TPU-natively (SURVEY.md §2.9, §5):
 
 1. capture  → :mod:`apex_tpu.prof.capture` (named scopes into HLO metadata,
    ``jax.profiler`` device traces, optional arg markers).
-2. parse    → the jaxpr/compiled-HLO *is* the database; no SQLite.
+2. parse    → :mod:`apex_tpu.prof.parse` reads the *measured* trace the
+   capture stage wrote (Chrome-trace JSON with per-HLO-op durations and
+   run ids — the CUPTI-SQLite analog) into per-kernel records; the static
+   jaxpr walk in :mod:`analysis` complements it with analytic costs.
 3. prof     → :mod:`apex_tpu.prof.analysis` (per-op flops/bytes/intensity
-   records, MXU-eligibility column, XLA cost-model cross-check).
+   records, MXU-eligibility column, XLA cost-model cross-check) +
+   :func:`apex_tpu.prof.parse.attach_measured` joining measured time onto
+   the analytic records.
 """
 
 from .analysis import OpRecord, Profile, profile_function   # noqa: F401
 from .capture import (init, annotate, scope, trace,          # noqa: F401
                       dump_markers, MARKERS)
+from .parse import (KernelRecord, TraceProfile, parse_trace,  # noqa: F401
+                    attach_measured)

@@ -77,10 +77,15 @@ _ELEMENTWISE = {
     "add", "sub", "mul", "div", "max", "min", "neg", "exp", "log", "tanh",
     "logistic", "rsqrt", "sqrt", "pow", "integer_pow", "abs", "sign",
     "floor", "ceil", "round", "erf", "select_n", "clamp", "and", "or",
-    "xor", "not", "eq", "ne", "ge", "gt", "le", "lt", "convert_element_type",
+    "xor", "not", "eq", "ne", "ge", "gt", "le", "lt",
     "erf_inv", "expm1", "log1p", "cos", "sin", "tan", "atan2", "cbrt",
-    "real", "imag", "stop_gradient", "copy", "nextafter", "squeeze",
+    "real", "imag", "nextafter",
 }
+# Pure data movement (dtype casts, layout/shape changes, identities): 0
+# flops — they cost bytes, not ALU work (ADVICE r1 #2).  They still produce
+# OpRecords so bandwidth accounting sees them.
+# (convert_element_type / squeeze / copy / stop_gradient intentionally NOT
+# in _ELEMENTWISE.)
 
 _REDUCTIONS = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
                "reduce_and", "reduce_or", "argmax", "argmin",
@@ -178,6 +183,10 @@ def _walk(jaxpr, records: List[OpRecord], scope: str, mult: int):
             _walk(inner, records, scope + f"/scan", mult * length)
             continue
         if prim == "while":
+            # Trip count is data-dependent and unknowable statically: body
+            # ops are counted ONCE (multiplicity 1) and tagged with a
+            # "/while" scope so totals are recognizably lower bounds for
+            # while-based programs (scan, with its static length, is exact).
             body = eqn.params.get("body_jaxpr")
             body = body.jaxpr if hasattr(body, "jaxpr") else body
             if body is not None:

@@ -1,0 +1,194 @@
+"""Measured-trace parsing — the ``pyprof.parse`` stage.
+
+The reference reads an nvprof CUPTI SQLite DB and emits one record per
+measured kernel, which ``pyprof.prof`` then joins with captured op markers
+(``apex/pyprof/parse/nvvp.py:14+``, ``prof/prof.py:39-56``).  The XLA
+equivalent: :func:`apex_tpu.prof.capture.trace` writes a TensorBoard
+profile directory containing a Chrome-trace JSON (``*.trace.json.gz``)
+whose complete events carry ``hlo_op`` / ``hlo_module`` / ``run_id`` args
+and a wall duration per executed HLO op.  This module:
+
+* :func:`parse_trace` — read the newest run in a trace logdir into
+  :class:`KernelRecord` rows (one per measured op execution) plus per-op
+  aggregates and per-``run_id`` step segmentation (the kernel↔iteration
+  association of the reference parse stage).
+* :func:`attach_measured` — join measured per-op durations onto the static
+  :class:`~apex_tpu.prof.analysis.OpRecord` rows by normalized op name, so
+  a single report shows measured time next to analytic FLOPs/bytes.
+
+The fprop↔bprop correlation of the reference (``findFpropKernel`` by seq
+id) maps onto ``run_id`` + HLO op-name suffix matching here: backward ops
+lowered from the same primitive share its base name (``dot_general.N``),
+so :func:`TraceProfile.by_op` groups them under one key.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional
+
+__all__ = ["KernelRecord", "TraceProfile", "parse_trace", "attach_measured"]
+
+
+class KernelRecord(NamedTuple):
+    """One measured HLO-op execution (the reference's per-kernel dict)."""
+    name: str              # raw hlo op name, e.g. "dot_general.1"
+    base_op: str           # normalized, e.g. "dot_general"
+    hlo_module: str        # e.g. "jit_step_fn"
+    duration_us: float
+    start_us: float
+    run_id: str            # one executable launch == one step
+    device: str
+
+
+_WRAP_RE = re.compile(r"^(?:wrapped_|fusion_)?(.*?)(?:\.\d+)?$")
+
+
+def _normalize(hlo_op: str) -> str:
+    m = _WRAP_RE.match(hlo_op)
+    base = m.group(1) if m else hlo_op
+    return base.replace("-", "_")
+
+
+def _newest_run_dir(logdir: str) -> str:
+    runs = sorted(glob.glob(os.path.join(logdir, "plugins", "profile", "*")))
+    if not runs:
+        raise FileNotFoundError(
+            f"no profile runs under {logdir!r} (expected "
+            f"plugins/profile/<timestamp>/) — did capture.trace run?")
+    return runs[-1]
+
+
+class TraceProfile:
+    """Parsed measured trace: records + aggregates + step segmentation."""
+
+    def __init__(self, records: List[KernelRecord]):
+        self.records = records
+
+    def by_op(self) -> Dict[str, dict]:
+        """Aggregate measured time per normalized op name."""
+        out: Dict[str, dict] = {}
+        for r in self.records:
+            agg = out.setdefault(r.base_op,
+                                 {"count": 0, "total_us": 0.0, "max_us": 0.0})
+            agg["count"] += 1
+            agg["total_us"] += r.duration_us
+            agg["max_us"] = max(agg["max_us"], r.duration_us)
+        for agg in out.values():
+            agg["mean_us"] = agg["total_us"] / agg["count"]
+        return out
+
+    def steps(self) -> Dict[str, float]:
+        """Wall time per ``run_id`` (one executable launch = one step) —
+        the kernel↔iteration association of the reference parse stage."""
+        out: Dict[str, float] = {}
+        for r in self.records:
+            out[r.run_id] = out.get(r.run_id, 0.0) + r.duration_us
+        return out
+
+    @property
+    def total_us(self) -> float:
+        return sum(r.duration_us for r in self.records)
+
+    def summary(self, top: int = 20) -> str:
+        rows = sorted(self.by_op().items(), key=lambda kv: -kv[1]["total_us"])
+        lines = ["{:<28} {:>7} {:>12} {:>12}".format(
+            "op", "count", "total_us", "mean_us")]
+        for name, agg in rows[:top]:
+            lines.append("{:<28} {:>7} {:>12.1f} {:>12.2f}".format(
+                name, agg["count"], agg["total_us"], agg["mean_us"]))
+        lines.append(f"TOTAL measured: {self.total_us:.1f} us over "
+                     f"{len(self.steps())} step(s)")
+        return "\n".join(lines)
+
+
+def parse_trace(logdir: str, module_filter: Optional[str] = None
+                ) -> TraceProfile:
+    """Parse the newest profile run under ``logdir`` into a
+    :class:`TraceProfile`.
+
+    ``module_filter``: keep only ops whose ``hlo_module`` contains the
+    substring (e.g. ``"step_fn"`` to drop unrelated eager ops).
+    """
+    run_dir = _newest_run_dir(logdir)
+    traces = glob.glob(os.path.join(run_dir, "*.trace.json.gz"))
+    if not traces:
+        raise FileNotFoundError(f"no *.trace.json.gz in {run_dir!r}")
+    records: List[KernelRecord] = []
+    for path in traces:
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        for e in data.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            args = e.get("args") or {}
+            hlo_op = args.get("hlo_op")
+            if not hlo_op:
+                continue
+            module = args.get("hlo_module", "")
+            if module_filter and module_filter not in module:
+                continue
+            records.append(KernelRecord(
+                name=hlo_op,
+                base_op=_normalize(hlo_op),
+                hlo_module=module,
+                duration_us=float(e.get("dur", 0.0)),
+                start_us=float(e.get("ts", 0.0)),
+                run_id=str(args.get("run_id", "")),
+                device=str(args.get("device_ordinal", ""))))
+    records.sort(key=lambda r: r.start_us)
+    return TraceProfile(records)
+
+
+# -- join with the static analysis (the reference ``prof`` stage input) -------
+
+_STATIC_ALIASES = {
+    # measured base op -> static primitive names it may cover
+    "reduce": ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"),
+    "reduce_window": ("reduce_window_sum", "reduce_window_max"),
+    "convolution": ("conv_general_dilated",),
+    "dot": ("dot_general",),
+}
+
+
+def attach_measured(profile, trace: TraceProfile, top: int = 20) -> str:
+    """Render the static analysis with measured time joined per op name —
+    analytic FLOPs/bytes next to actual microseconds (the reference's
+    final per-op report, ``pyprof/prof/output.py``)."""
+    measured = trace.by_op()
+    # expand aliases onto static primitive names
+    joined: Dict[str, dict] = dict(measured)
+    for meas_name, prims in _STATIC_ALIASES.items():
+        if meas_name in measured:
+            for p in prims:
+                joined.setdefault(p, measured[meas_name])
+
+    static_by_op: Dict[str, dict] = {}
+    for r in profile.records:
+        agg = static_by_op.setdefault(r.op, {"flops": 0.0, "bytes": 0.0})
+        agg["flops"] += r.flops * r.count
+        agg["bytes"] += r.bytes * r.count
+
+    lines = ["{:<24} {:>13} {:>13} {:>11} {:>11}".format(
+        "op", "flops", "bytes", "meas_us", "GFLOP/s")]
+    order = sorted(static_by_op.items(),
+                   key=lambda kv: -joined.get(kv[0], {}).get("total_us", 0.0))
+    for op, agg in order[:top]:
+        m = joined.get(op)
+        if m:
+            us = m["total_us"]
+            rate = agg["flops"] / us / 1e3 if us else 0.0
+            lines.append("{:<24} {:>13.3g} {:>13.3g} {:>11.1f} {:>11.1f}"
+                         .format(op, agg["flops"], agg["bytes"], us, rate))
+        else:
+            lines.append("{:<24} {:>13.3g} {:>13.3g} {:>11} {:>11}"
+                         .format(op, agg["flops"], agg["bytes"], "-", "-"))
+    unmatched = sorted(set(measured) - set(static_by_op)
+                       - set(_STATIC_ALIASES))
+    if unmatched:
+        lines.append("measured-only ops: " + ", ".join(unmatched[:10]))
+    return "\n".join(lines)

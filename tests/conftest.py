@@ -17,13 +17,28 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# APEX_TPU_TESTS=1 leaves the default device on the real TPU so the
+# ``tpu``-marked kernel tests (test_pallas_tpu.py) exercise the Mosaic
+# kernels on chip; everything else still builds its meshes from CPU devices.
+_ON_CHIP = bool(os.environ.get("APEX_TPU_TESTS"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
-jax.config.update("jax_default_device", jax.devices("cpu")[0])
+if not _ON_CHIP:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    skip = pytest.mark.skip(
+        reason="TPU kernel test: set APEX_TPU_TESTS=1 on a TPU host")
+    run_on_chip = _ON_CHIP and jax.default_backend() == "tpu"
+    for item in items:
+        if "tpu" in item.keywords and not run_on_chip:
+            item.add_marker(skip)
 
 
 @pytest.fixture

@@ -220,3 +220,90 @@ def test_cast_cache_is_identity_checked():
     assert out_y.shape == (5,)                 # not the stale (3,) cast
     np.testing.assert_allclose(np.asarray(out_y, np.float32), 2.0)
     autocast.clear_cast_cache()
+
+
+# -- initialize validation surface (reference _initialize.py:60-126) ---------
+
+def test_initialize_rejects_half_params():
+    """check_params_fp32 analog: reduced-precision incoming params error."""
+    from apex_tpu.optimizers import FusedSGD
+    params = {"w": jnp.ones((3,), jnp.bfloat16)}
+    opt = FusedSGD(params, lr=0.1)
+    with pytest.raises(RuntimeError, match="expected float32"):
+        amp.initialize(params, opt, opt_level="O2", verbosity=0)
+
+
+def test_initialize_allows_half_params_at_o3():
+    params = {"w": jnp.ones((3,), jnp.bfloat16)}
+    out = amp.initialize(params, opt_level="O3", verbosity=0)
+    assert out["w"].dtype == jnp.bfloat16
+
+
+def test_initialize_rejects_wrapped_optimizer():
+    """check_optimizers analog: FP16_Optimizer must not be passed in."""
+    from apex_tpu.optimizers import FP16_Optimizer, FusedSGD
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    wrapped = FP16_Optimizer(FusedSGD(params, lr=0.1))
+    with pytest.raises(RuntimeError, match="must be bare"):
+        amp.initialize(params, wrapped, opt_level="O2", verbosity=0)
+
+
+def test_initialize_rejects_ddp_wrapped_model():
+    from apex_tpu.parallel import DistributedDataParallel
+
+    class _Apply:
+        def __call__(self, params, x):
+            return x
+    ddp = DistributedDataParallel.__new__(DistributedDataParallel)
+    with pytest.raises(RuntimeError, match="AFTER"):
+        amp.initialize(ddp, opt_level="O2", verbosity=0)
+
+
+# -- O1 cast-list breadth + banned functions ---------------------------------
+
+def test_o1_broadened_fp32_list():
+    from apex_tpu.amp import autocast
+    import jax.nn as jnn
+    autocast.init(enabled=True)
+    try:
+        x = jnp.ones((4,), jnp.bfloat16)
+        assert jnn.gelu(x).dtype == jnp.float32
+        assert jnn.sigmoid(x).dtype == jnp.float32
+        assert jnp.linalg.norm(x).dtype == jnp.float32
+        assert jnp.arccos(x * 0).dtype == jnp.float32
+    finally:
+        autocast.shutdown()
+
+
+def test_banned_bce_raises_under_fp16_runs_under_bf16():
+    from apex_tpu.amp import autocast
+    from apex_tpu.ops import losses
+    probs = jnp.asarray([0.3, 0.7], jnp.float32)
+    targets = jnp.asarray([0.0, 1.0])
+
+    autocast.init(enabled=True, half_dtype=jnp.float16)
+    try:
+        with pytest.raises(NotImplementedError, match="float range"):
+            losses.binary_cross_entropy(probs, targets)
+    finally:
+        autocast.shutdown()
+
+    autocast.init(enabled=True)   # bf16 default: runs in fp32 instead
+    try:
+        out = losses.binary_cross_entropy(probs, targets)
+        assert out.dtype == jnp.float32
+        ref = -np.mean([np.log(0.7), np.log(0.7)])
+        np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+    finally:
+        autocast.shutdown()
+
+
+def test_initialize_disabled_restores_patches():
+    """enabled=False tears the autocast patches down (weak-#7 wiring)."""
+    from apex_tpu.amp import autocast
+    import jax.numpy as jnp_mod
+    autocast.init(enabled=True)
+    assert hasattr(jnp_mod.matmul, "__amp_original__")
+    amp.initialize(enabled=False, verbosity=0)
+    assert not hasattr(jnp_mod.matmul, "__amp_original__")
+    assert not autocast._patched

@@ -1,0 +1,162 @@
+"""L1 cross-product convergence gate.
+
+Re-design of the reference's main end-to-end correctness harness
+(``tests/L1/common/run_test.sh:21-120`` sweeps {O0-O3} x {default, 1.0,
+128.0, dynamic loss scale} x {keep_batchnorm_fp32 T/F} and
+``compare.py:36-64`` asserts identical loss trajectories between installs).
+
+The TPU translation of "install-vs-install bitwise equality":
+
+* within one opt level, the loss trajectory must be BITWISE identical
+  across loss scales {1.0, 128.0, dynamic} — power-of-two scaling and
+  unscaling is exact in fp32/bf16, so any drift is a scaling-machinery bug;
+* each opt level's trajectory must track the O0 fp32 oracle inside a
+  precision-appropriate tolerance ladder (cf. the reference's fp16/fp64
+  ladder in ``two_gpu_unit_test.py:40-46``);
+* keep_batchnorm_fp32 True/False both converge at O2/O3;
+* (on chip, ``-m tpu``) the Pallas kernel path and the jnp fallback path
+  produce matching trajectories over a real training run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import training
+from apex_tpu.amp import autocast
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.training import make_train_step
+
+STEPS = 6
+_OPT_LEVELS = ("O0", "O1", "O2", "O3")
+_SCALES = (1.0, 128.0, "dynamic")
+
+
+class TinyModel(nn.Module):
+    """conv + BatchNorm + FusedLayerNorm + dense: touches the keep-bn cast
+    split, the norm kernels, and the MXU ops in a few thousand params."""
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.Conv(8, (3, 3), dtype=self.dtype, param_dtype=jnp.float32,
+                    name="conv0")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="bn0")(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(32, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="dense0")(x)
+        x = FusedLayerNorm(normalized_shape=32, name="ln0")(x).astype(
+            self.dtype)
+        return nn.Dense(10, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="head")(x)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 8, 8, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, (16,)))
+    return x, y
+
+
+def run_config(opt_level, loss_scale, keep_bn=True, steps=STEPS):
+    """Train TinyModel ``steps`` steps; return the fp32 loss trajectory."""
+    dtype = jnp.bfloat16 if opt_level in ("O2", "O3") else jnp.float32
+    model = TinyModel(dtype=dtype)
+    x, y = _data()
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, ms, batch):
+        xb, yb = batch
+        logits, upd = model.apply({"params": p, "batch_stats": ms}, xb,
+                                  train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+        return loss, upd["batch_stats"]
+
+    tx = training.sgd(lr=0.05, momentum=0.9)
+    if opt_level == "O1":
+        autocast.init(enabled=True)
+    try:
+        init_fn, step_fn = make_train_step(
+            loss_fn, tx, opt_level=opt_level, loss_scale=loss_scale,
+            keep_batchnorm_fp32=keep_bn, has_model_state=True)
+        state = init_fn(params, batch_stats)
+        step = jax.jit(step_fn)
+        losses = []
+        for _ in range(steps):
+            state, metrics = step(state, (x, y))
+            losses.append(float(metrics["loss"]))
+    finally:
+        if opt_level == "O1":
+            autocast.shutdown()
+    return np.asarray(losses)
+
+
+# Tolerance ladder vs the fp32 oracle (reference two_gpu_unit_test.py:40-46).
+_TOL = {"O0": 0.0, "O1": 0.08, "O2": 0.08, "O3": 0.15}
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return run_config("O0", 1.0)
+
+
+@pytest.mark.parametrize("opt_level", _OPT_LEVELS)
+def test_loss_scale_invariance(opt_level):
+    """{1.0, 128.0, dynamic} trajectories are ulp-identical within one opt
+    level (the install-parity analog of compare.py:36-64).  Power-of-two
+    scale/unscale is exact arithmetic; the tolerance only absorbs XLA
+    re-fusion between the scaled and fast-path programs (the scale==1.0
+    config compiles without the scaling ops at all)."""
+    base = run_config(opt_level, _SCALES[0])
+    for scale in _SCALES[1:]:
+        traj = run_config(opt_level, scale)
+        np.testing.assert_allclose(
+            traj, base, rtol=1e-5, atol=0,
+            err_msg=f"{opt_level}: loss_scale={scale} diverged from 1.0")
+
+
+@pytest.mark.parametrize("opt_level", ("O1", "O2", "O3"))
+def test_trajectory_tracks_fp32_oracle(opt_level, oracle):
+    traj = run_config(opt_level, "dynamic")
+    assert traj.shape == oracle.shape
+    np.testing.assert_allclose(
+        traj, oracle, atol=_TOL[opt_level], rtol=0,
+        err_msg=f"{opt_level} trajectory left the tolerance ladder")
+    assert traj[-1] < traj[0], f"{opt_level} did not reduce the loss"
+
+
+@pytest.mark.parametrize("opt_level", ("O2", "O3"))
+@pytest.mark.parametrize("keep_bn", (True, False))
+def test_keep_batchnorm_cross_product(opt_level, keep_bn, oracle):
+    traj = run_config(opt_level, 128.0, keep_bn=keep_bn)
+    np.testing.assert_allclose(
+        traj, oracle, atol=2 * _TOL[opt_level] if not keep_bn
+        else _TOL[opt_level], rtol=0)
+    assert traj[-1] < traj[0]
+
+
+def test_o0_matches_oracle_bitwise(oracle):
+    np.testing.assert_array_equal(run_config("O0", 1.0), oracle)
+
+
+@pytest.mark.tpu
+def test_pallas_vs_fallback_trajectory_on_chip():
+    """Fallback-vs-kernel over a training run (the L1 fused-vs-python gate,
+    reference run_test.sh two-install sweep)."""
+    with jax.default_device(jax.devices("tpu")[0]):
+        kernel_traj = run_config("O2", "dynamic")
+        os.environ["APEX_TPU_DISABLE_PALLAS"] = "1"
+        try:
+            fallback_traj = run_config("O2", "dynamic")
+        finally:
+            del os.environ["APEX_TPU_DISABLE_PALLAS"]
+    np.testing.assert_allclose(kernel_traj, fallback_traj, atol=2e-2, rtol=0)

@@ -107,3 +107,45 @@ def test_jit_composability():
     norm, overflow = f([jnp.ones((16,))])
     np.testing.assert_allclose(float(norm), 8.0, rtol=1e-6)
     assert not bool(overflow)
+
+
+# -- legacy two-stage LAMB (reference csrc/multi_tensor_lamb_stage_{1,2}.cu) --
+
+def test_lamb_two_stage_matches_numpy_reference():
+    from apex_tpu.multi_tensor import (multi_tensor_l2norm,
+                                       multi_tensor_lamb_stage1,
+                                       multi_tensor_lamb_stage2)
+    rng = np.random.RandomState(0)
+    shapes = [(4, 3), (5,), (2, 2)]
+    params = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in shapes]
+    grads = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in shapes]
+    m = [jnp.zeros(s, jnp.float32) for s in shapes]
+    v = [jnp.zeros(s, jnp.float32) for s in shapes]
+    decay = [0.01, 0.0, 0.01]
+    b1, b2, eps, lr, gnorm = 0.9, 0.999, 1e-6, 0.1, 2.0
+
+    upd, m1, v1 = multi_tensor_lamb_stage1(
+        grads, params, m, v, decay, beta1=b1, beta2=b2,
+        beta1_correction=1 - b1, beta2_correction=1 - b2,
+        epsilon=eps, clipped_global_grad_norm=gnorm)
+    _, p_norms = multi_tensor_l2norm(params, per_tensor=True)
+    _, u_norms = multi_tensor_l2norm(upd, per_tensor=True)
+    new_p = multi_tensor_lamb_stage2(params, upd, p_norms, u_norms, lr)
+
+    for g, p, d, u_got, p_got in zip(grads, params, decay, upd, new_p):
+        g = np.asarray(g); p = np.asarray(p)
+        sg = g / gnorm
+        m_n = (1 - b1) * sg
+        v_n = (1 - b2) * sg * sg
+        u_ref = (m_n / (1 - b1)) / (np.sqrt(v_n / (1 - b2)) + eps) + d * p
+        np.testing.assert_allclose(np.asarray(u_got), u_ref,
+                                   atol=1e-5, rtol=1e-5)
+        pn = np.linalg.norm(p); un = np.linalg.norm(u_ref)
+        ratio = lr * pn / un if (pn != 0 and un != 0) else lr
+        np.testing.assert_allclose(np.asarray(p_got), p - ratio * u_ref,
+                                   atol=1e-5, rtol=1e-5)
+    # moments updated in place semantics
+    np.testing.assert_allclose(np.asarray(m1[0]),
+                               (1 - b1) * np.asarray(grads[0]) / gnorm,
+                               rtol=1e-6)
+    assert np.all(np.asarray(v1[0]) >= 0)

@@ -201,3 +201,138 @@ def test_optimizer_state_dict_roundtrip():
     for k in params:
         np.testing.assert_array_equal(np.asarray(opt.params[k]),
                                       np.asarray(opt2.params[k]))
+
+
+# -- parameter groups (reference fused_adam.py:75-134 iterates param_groups
+# with per-group lr/wd; LARC.py:71-97 absorbs per-group weight decay) --------
+
+def test_param_groups_match_separate_optimizers():
+    """Two groups with different lr/wd must step exactly like two separate
+    single-group optimizers over the same subtrees."""
+    decay = _rand_tree(11, shapes=((4, 3), (5,)))
+    no_decay = _rand_tree(12, shapes=((3,), (2, 2)))
+    grouped = FusedAdam([
+        {"params": decay, "lr": 1e-2, "weight_decay": 0.1},
+        {"params": no_decay, "lr": 5e-3, "weight_decay": 0.0},
+    ], lr=999.0, weight_decay=999.0)   # defaults must be overridden
+
+    ref_a = FusedAdam(decay, lr=1e-2, weight_decay=0.1)
+    ref_b = FusedAdam(no_decay, lr=5e-3, weight_decay=0.0)
+
+    for step in range(3):
+        g_decay = {k: jnp.full_like(v, 0.1 * (step + 1))
+                   for k, v in decay.items()}
+        g_nodecay = {k: jnp.full_like(v, -0.2) for k, v in no_decay.items()}
+        grouped.step(grads=[g_decay, g_nodecay])
+        ref_a.step(grads=g_decay)
+        ref_b.step(grads=g_nodecay)
+
+    got_a, got_b = grouped.params
+    for k in decay:
+        np.testing.assert_array_equal(np.asarray(got_a[k]),
+                                      np.asarray(ref_a.params[k]))
+    for k in no_decay:
+        np.testing.assert_array_equal(np.asarray(got_b[k]),
+                                      np.asarray(ref_b.params[k]))
+
+
+def test_param_groups_bert_no_decay_recipe():
+    """The BERT recipe: no weight decay on bias/LayerNorm params."""
+    params = {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+        "ln": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+    }
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def is_no_decay(path):
+        names = [getattr(p, "key", "") for p in path]
+        return "bias" in names or "ln" in names
+
+    decay = {"dense_kernel": params["dense"]["kernel"]}
+    no_decay = {"dense_bias": params["dense"]["bias"],
+                "ln_scale": params["ln"]["scale"],
+                "ln_bias": params["ln"]["bias"]}
+    assert sum(1 for p, _ in flat if is_no_decay(p)) == len(no_decay)
+
+    opt = FusedAdam([
+        {"params": decay, "weight_decay": 0.01},
+        {"params": no_decay, "weight_decay": 0.0},
+    ], lr=1e-3)
+    grads = [{k: jnp.zeros_like(v) for k, v in decay.items()},
+             {k: jnp.zeros_like(v) for k, v in no_decay.items()}]
+    opt.step(grads=grads)
+    new_decay, new_no_decay = opt.params
+    # zero grads: only wd moves params -> decay group shrinks, no-decay frozen
+    assert np.all(np.asarray(new_decay["dense_kernel"]) < 1.0)
+    np.testing.assert_array_equal(np.asarray(new_no_decay["ln_scale"]),
+                                  np.ones((4,)))
+
+
+def test_add_param_group():
+    base = _rand_tree(13, shapes=((3,),))
+    extra = _rand_tree(14, shapes=((2, 2),))
+    opt = FusedAdam(base, lr=1e-2)
+    opt.add_param_group({"params": extra, "lr": 1e-3})
+    assert len(opt.param_groups) == 2
+    grads = [{k: jnp.ones_like(v) for k, v in base.items()},
+             {k: jnp.ones_like(v) for k, v in extra.items()}]
+    opt.step(grads=grads)
+    p0, p1 = opt.params
+    assert not np.allclose(np.asarray(p0["p0"]), np.asarray(base["p0"]))
+    assert not np.allclose(np.asarray(p1["p0"]), np.asarray(extra["p0"]))
+
+
+def test_larc_per_group_weight_decay():
+    from apex_tpu.parallel import LARC
+    decay = _rand_tree(15, shapes=((4,),))
+    no_decay = _rand_tree(16, shapes=((4,),))
+    opt = LARC(FusedSGD([
+        {"params": decay, "weight_decay": 0.1},
+        {"params": no_decay, "weight_decay": 0.0},
+    ], lr=1e-2, momentum=0.0))
+    grads = [{k: jnp.full_like(v, 0.01) for k, v in decay.items()},
+             {k: jnp.full_like(v, 0.01) for k, v in no_decay.items()}]
+    before = jax.device_get(opt.optim.params)
+    opt.step(grads=grads)
+    after = jax.device_get(opt.optim.params)
+    # wd absorbed into LARC grads, restored afterwards on the group
+    assert opt.optim.param_groups[0]["weight_decay"] == 0.1
+    assert opt.optim.param_groups[1]["weight_decay"] == 0.0
+    assert not np.allclose(after[0]["p0"], before[0]["p0"])
+
+
+def test_larc_with_amp_masters_single_group():
+    """Regression: LARC.step with an O2-wired (master-weights) optimizer
+    built from a plain params pytree must use the canonical group list."""
+    from apex_tpu import amp
+    from apex_tpu.parallel import LARC
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = FusedSGD(params, lr=0.1, weight_decay=0.01)
+    params, opt = amp.initialize(params, opt, opt_level="O2", verbosity=0,
+                                 loss_scale=1.0)
+    larc = LARC(opt)
+    grads = {"w": jnp.full((4,), 0.1, jnp.bfloat16)}
+    larc.step(grads=grads)
+    assert opt.param_groups[0]["weight_decay"] == 0.01   # restored
+    assert not np.allclose(np.asarray(opt.master_params["w"]), 1.0)
+
+
+def test_grouped_optimizer_amp_initialize_o2():
+    """Regression: amp.initialize with a grouped optimizer must cast each
+    group's own params (the i-th model pytree does not match the group
+    structure)."""
+    from apex_tpu import amp
+    decay = {"kernel": jnp.ones((4, 4))}
+    no_decay = {"bias": jnp.ones((4,))}
+    opt = FusedAdam([{"params": decay, "weight_decay": 0.01},
+                     {"params": no_decay, "weight_decay": 0.0}], lr=1e-3)
+    _, opt = amp.initialize([decay, no_decay], opt, opt_level="O2",
+                            loss_scale=1.0, verbosity=0)
+    assert opt.params[0]["kernel"].dtype == jnp.bfloat16
+    assert opt.master_params[0]["kernel"].dtype == jnp.float32
+    grads = [{"kernel": jnp.full((4, 4), 0.1, jnp.bfloat16)},
+             {"bias": jnp.full((4,), 0.1, jnp.bfloat16)}]
+    with amp.scale_loss(jnp.float32(1.0), opt):
+        opt.backward(grads)
+    opt.step()
+    assert not np.allclose(np.asarray(opt.master_params[0]["kernel"]), 1.0)

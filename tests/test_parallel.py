@@ -364,3 +364,47 @@ def test_larc_wrapper_steps():
                                rtol=1e-5)
     # wd restored after step
     assert opt.optim.defaults["weight_decay"] == 0.1
+
+
+# -- grouped psum lowering (VERDICT r2 #8: scalable subgroup collectives) ----
+
+def test_group_psum_butterfly_matches_expected():
+    """Power-of-two groups take the ppermute butterfly path and sum exactly
+    within each group."""
+    from apex_tpu.parallel.distributed import group_psum
+    mesh = _mesh()
+    groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    x = jnp.arange(NDEV, dtype=jnp.float32) + 1.0       # 1..8
+    f = _shmap(lambda v: group_psum(v, "data", groups), mesh,
+               P("data"), P("data"))
+    out = np.asarray(jax.jit(f)(x))
+    np.testing.assert_array_equal(out[:4], np.full(4, 10.0))   # 1+2+3+4
+    np.testing.assert_array_equal(out[4:], np.full(4, 26.0))   # 5+6+7+8
+
+
+def test_group_psum_butterfly_no_full_world_gather():
+    """The lowered HLO for power-of-two groups must contain collective
+    permutes, not a full-world all-gather (pod-scalability contract)."""
+    from apex_tpu.parallel.distributed import group_psum
+    mesh = _mesh()
+    groups = [[0, 1], [2, 3], [4, 5], [6, 7]]
+    f = jax.jit(_shmap(lambda v: group_psum(v, "data", groups), mesh,
+                       P("data"), P("data")))
+    hlo = f.lower(jnp.zeros((NDEV, 16), jnp.float32)).as_text()
+    assert ("collective_permute" in hlo) or ("collective-permute" in hlo)
+    assert "all_gather" not in hlo and "all-gather" not in hlo
+
+
+def test_group_psum_irregular_groups_fallback():
+    """Unequal group sizes fall back to the gather+mask lowering and still
+    produce correct per-group sums."""
+    from apex_tpu.parallel.distributed import group_psum
+    mesh = _mesh()
+    groups = [[0, 1, 2], [3, 4, 5], [6, 7]]
+    x = jnp.arange(NDEV, dtype=jnp.float32) + 1.0
+    f = _shmap(lambda v: group_psum(v, "data", groups), mesh,
+               P("data"), P("data"))
+    out = np.asarray(jax.jit(f)(x))
+    np.testing.assert_array_equal(out[:3], np.full(3, 6.0))
+    np.testing.assert_array_equal(out[3:6], np.full(3, 15.0))
+    np.testing.assert_array_equal(out[6:], np.full(2, 15.0))

@@ -120,3 +120,47 @@ def test_dump_markers(tmp_path):
     lines = [json.loads(l) for l in path.read_text().splitlines()]
     assert lines[0]["op"] == "g"
     assert lines[0]["kwargs"]["flag"]["value"] is False
+
+
+# -- measured-trace parse stage (VERDICT r2 #6) -------------------------------
+
+def test_parse_trace_roundtrip(tmp_path):
+    """Capture a REAL device trace, parse it back, and join measured
+    durations onto the static analysis (reference pyprof parse stage,
+    ``parse/nvvp.py`` + ``prof/prof.py:39-56``)."""
+    from apex_tpu import prof as P
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(jnp.tanh(x @ x))
+
+    x = jnp.ones((256, 256), jnp.float32)
+    f(x).block_until_ready()              # compile outside the trace window
+    with P.trace(str(tmp_path)):
+        for _ in range(3):
+            r = f(x)
+        r.block_until_ready()
+
+    tp = P.parse_trace(str(tmp_path))
+    assert tp.records, "no measured kernel records parsed"
+    by_op = tp.by_op()
+    assert any(k.startswith("dot") for k in by_op), by_op.keys()
+    dot_key = next(k for k in by_op if k.startswith("dot"))
+    assert by_op[dot_key]["count"] >= 3          # one per traced iteration
+    assert by_op[dot_key]["total_us"] > 0
+    # step segmentation: one run_id per executed iteration
+    assert len(tp.steps()) >= 3
+    assert tp.summary()
+
+    static = P.profile_function(f, x, xla_cost=False)
+    report = P.attach_measured(static, tp)
+    # the joined report shows measured microseconds on the matmul row
+    dot_line = next(l for l in report.splitlines()
+                    if l.startswith("dot_general"))
+    assert "-" not in dot_line.split()[3], report
+
+
+def test_parse_trace_missing_dir_raises(tmp_path):
+    from apex_tpu import prof as P
+    with pytest.raises(FileNotFoundError):
+        P.parse_trace(str(tmp_path / "nope"))
