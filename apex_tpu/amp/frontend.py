@@ -60,7 +60,13 @@ def initialize(models=None,
         # _initialize.py:42-56 returns everything untouched).
         autocast.shutdown()
         _amp_state.opt_properties = Properties()
-        return _unlistify(models, optimizers)
+        # Inputs pass through untouched and keep their exact shape —
+        # including lists, which must not be collapsed to their first
+        # element (reference _initialize.py:42-56).
+        return _unlistify(models, optimizers,
+                          models_was_list=True, optimizers_was_list=True,
+                          had_models=models is not None,
+                          had_optimizers=optimizers is not None)
 
     if opt_level not in opt_levels:
         raise AmpOptionError(

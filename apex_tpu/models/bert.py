@@ -22,12 +22,15 @@ class BertSelfAttention(nn.Module):
     """Self-attention with a pluggable compute strategy.
 
     ``attention_impl``: ``"full"`` (materialized scores, the oracle),
-    ``"blockwise"`` (flash-style online softmax, O(T) memory), ``"ring"``
-    (ring attention over sequence shards — call inside shard_map with the
-    sequence split over ``sp_axis``), or ``"ulysses"`` (all-to-all head
-    resharding).  Ring/Ulysses are the long-context paths; they take the
-    padding mask only via causal=False full-visibility (use blockwise bias
-    for padding within a shard-local setting).
+    ``"blockwise"`` (flash-style online softmax in jnp, O(T) memory),
+    ``"flash"`` (the Pallas TPU kernel of
+    ``apex_tpu/ops/flash_attention.py``; falls back to blockwise off-TPU),
+    ``"ring"`` (ring attention over sequence shards — call inside
+    shard_map with the sequence split over ``sp_axis``), or ``"ulysses"``
+    (all-to-all head resharding).  Ring/Ulysses are the long-context
+    paths; they take the padding mask only via causal=False
+    full-visibility (use blockwise/flash bias for padding within a
+    shard-local setting).
     """
     num_heads: int
     dtype: Any = jnp.float32
@@ -55,6 +58,13 @@ class BertSelfAttention(nn.Module):
             fn = (ring_attention if self.attention_impl == "ring"
                   else ulysses_attention)
             ctx = fn(q, k, v, self.sp_axis, causal=self.causal)
+        elif self.attention_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+            kb = None
+            if mask is not None:
+                kb = jnp.where(mask, 0.0, -1e9)
+            ctx = flash_attention(q, k, v, causal=self.causal,
+                                  key_padding_bias=kb)
         elif self.attention_impl == "blockwise":
             from ..ops.attention import blockwise_attention
             bias = None
@@ -110,7 +120,7 @@ class BertEncoder(nn.Module):
     type_vocab_size: int = 2
     num_classes: Optional[int] = 2     # fine-tune head; None = features
     dtype: Any = jnp.float32
-    attention_impl: str = "full"       # full | blockwise | ring | ulysses
+    attention_impl: str = "full"   # full | blockwise | flash | ring | ulysses
     sp_axis: Optional[str] = None      # mesh axis for ring/ulysses
 
     @nn.compact

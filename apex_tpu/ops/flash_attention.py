@@ -1,0 +1,439 @@
+"""Flash attention — Pallas TPU kernels with custom VJP.
+
+Beyond-parity component (the reference has no attention code at all,
+SURVEY.md §5 "Long-context"): the hot op of every transformer, built the
+TPU way.  The jnp blockwise path (``apex_tpu/ops/attention.py``) is the
+numerics oracle and the off-TPU fallback; the kernels here keep the whole
+online-softmax recurrence in VMEM so the [T, S] score matrix never touches
+HBM in either direction.
+
+Design:
+
+* **forward** — grid ``(batch, heads, q_blocks, kv_blocks)`` with the KV
+  block innermost; VMEM scratch carries the running (row-max ``m``,
+  denominator ``l``, unnormalized accumulator ``acc``) across KV steps and
+  the output + logsumexp are written on the last step.  Saving only
+  ``lse = m + log l`` (one fp32 per row) is what makes the backward
+  recompute exact — the same memory trick as the reference's fused
+  xentropy kernel (``csrc/xentropy_kernel.cu`` saves max_log_sum_exp).
+* **backward** — two kernels, both recomputing ``p = exp(s - lse)``:
+  ``dq`` iterates KV blocks innermost (accumulating ``ds @ k``), ``dk/dv``
+  iterates Q blocks innermost.  Every matmul is expressed in the natural
+  ``[bq, bk]`` orientation with leading-dim contractions where the output
+  is K-major, so no operand ever needs a VMEM relayout/transpose.
+  ``delta = rowsum(do * o)`` is a cheap jnp reduction fused by XLA.
+* causal masking skips fully-masked KV blocks via ``pl.when`` predication;
+  a key-side additive bias of shape ``[batch, kv_len]`` covers padding
+  masks (a full ``[B,H,T,S]`` bias falls back to the jnp path).
+* per-row stats (``lse``, ``delta``) travel as ``[B, H, T, 1]`` so kernel
+  blocks are ``(bq, 1)`` column vectors — the layout the FusedLayerNorm
+  kernel already uses for mean/invvar — avoiding lane-replication waste.
+
+All matmuls run on the MXU with fp32 accumulation
+(``preferred_element_type``); ``p`` is cast back to the value dtype before
+the PV matmul so bf16 inputs stay on the fast path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU-only import; absent on CPU-only installs.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ..normalization.fused_layer_norm import _use_pallas
+
+NEG_INF = -1e30
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 512
+
+
+def _pick_block(t: int, preferred: int) -> Optional[int]:
+    """Largest block <= preferred that divides t and is a multiple of 128
+    (or t itself when t <= preferred — sublanes pad internally)."""
+    if t <= preferred:
+        return t
+    for blk in range(preferred, 127, -128):
+        if t % blk == 0:
+            return blk
+    return None
+
+
+def _causal_block_mask(qi, ki, bq, bk):
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+def _when(cond):
+    """``pl.when`` that also accepts a static Python ``True``."""
+    if cond is True:
+        return lambda f: f()
+    return pl.when(cond)
+
+
+def _mm(a, b, dims):
+    """MXU matmul with fp32 accumulation.  Precision must be explicit: the
+    global ``jax_default_matmul_precision=highest`` (set by the test
+    conftest) lowers bf16 operands to an fp32 contract_precision Mosaic
+    cannot compile ("Bad lhs type"); fp32 operands conversely need HIGHEST
+    to match the oracle instead of TPU's default one-pass bf16 multiply."""
+    prec = (lax.Precision.HIGHEST
+            if a.dtype == jnp.float32 and b.dtype == jnp.float32
+            else lax.Precision.DEFAULT)
+    return lax.dot_general(a, b, (dims, ((), ())),
+                           preferred_element_type=jnp.float32,
+                           precision=prec)
+
+
+# -- forward kernel ------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, out_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, has_bias):
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qi = pl.program_id(2)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: fully-masked KV blocks above the diagonal are skipped.
+    run = (qi * bq + bq - 1 >= ki * bk) if causal else True
+
+    @_when(run)
+    def _():
+        q = q_ref[0, 0]                                  # [bq, d]
+        k = k_ref[0, 0]                                  # [bk, d]
+        s = _mm(q, k, ((1,), (1,))) * sm_scale   # [bq, bk]
+        if has_bias:
+            s = s + kb_ref[0].astype(jnp.float32)
+        if causal:
+            mask = _causal_block_mask(qi, ki, bq, bk)
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:]                                # [bq, 1]
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = _mm(p.astype(v_ref.dtype), v_ref[0, 0],
+                 ((1,), (0,)))                           # [bq, d]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_scr[:] / safe).astype(out_ref.dtype)
+        lse_ref[0, 0] = jnp.where(l == 0.0, NEG_INF,
+                                  m_scr[:] + jnp.log(safe))
+
+
+def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
+                      interpret=False):
+    """q,k,v: [B, H, T, D] (head-major).  kbias: [B, S] or None.
+    Returns (out [B,H,T,D], lse [B,H,T,1] fp32)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    has_bias = kbias is not None
+    kb = (kbias[:, None, :] if has_bias
+          else jnp.zeros((b, 1, 128), jnp.float32))
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               has_bias=has_bias)
+    kb_block = block_k if has_bias else 128
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, kb_block),
+                         (lambda b, h, qi, ki: (b, 0, ki)) if has_bias
+                         else (lambda b, h, qi, ki: (b, 0, 0))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, kb)
+    return out, lse
+
+
+# -- backward kernels ----------------------------------------------------------
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+                    qi, ki, *, sm_scale, causal, has_bias):
+    """Shared bwd recompute: returns (p, ds), both [bq, bk] fp32."""
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    s = _mm(q, k, ((1,), (1,))) * sm_scale       # [bq, bk]
+    if has_bias:
+        s = s + kb_ref[0].astype(jnp.float32)
+    if causal:
+        mask = _causal_block_mask(qi, ki, bq, bk)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0, 0])                           # lse: [bq, 1]
+    dp = _mm(do_ref[0, 0], v_ref[0, 0], ((1,), (1,)))        # [bq, bk]
+    ds = p * (dp - delta_ref[0, 0]) * sm_scale               # delta: [bq, 1]
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+                   dq_ref, dq_scr, *, sm_scale, causal, has_bias):
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qi = pl.program_id(2)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (qi * bq + bq - 1 >= ki * bk) if causal else True
+
+    @_when(run)
+    def _():
+        _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, kb_ref, qi, ki, sm_scale=sm_scale,
+                                causal=causal, has_bias=has_bias)
+        dq_scr[:] = dq_scr[:] + _mm(ds.astype(k_ref.dtype), k_ref[0, 0],
+                                    ((1,), (0,)))
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+                    *refs, sm_scale, causal, has_bias):
+    if has_bias:
+        dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr = refs
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+        db_ref = db_scr = None
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    ki = pl.program_id(2)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        if has_bias:
+            db_scr[:] = jnp.zeros_like(db_scr)
+
+    run = (qi * bq + bq - 1 >= ki * bk) if causal else True
+
+    @_when(run)
+    def _():
+        p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, kb_ref, qi, ki, sm_scale=sm_scale,
+                                causal=causal, has_bias=has_bias)
+        do = do_ref[0, 0]
+        # K-major outputs via leading-dim contraction — no transposes.
+        dv_scr[:] = dv_scr[:] + _mm(p.astype(do.dtype), do,
+                                    ((0,), (0,)))            # [bk, d]
+        dk_scr[:] = dk_scr[:] + _mm(ds.astype(q_ref.dtype), q_ref[0, 0],
+                                    ((0,), (0,)))            # [bk, d]
+        if has_bias:
+            # d(loss)/d(bias) column-sum: ds carries an extra sm_scale
+            # factor (it is dL/ds * sm_scale for the dq/dk matmuls), which
+            # the caller divides back out.
+            db_scr[:] = db_scr[:] + jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+        if has_bias:
+            db_ref[0, 0] = db_scr[:]
+
+
+def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
+                      block_q, block_k, interpret=False):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    has_bias = kbias is not None
+    kb = (kbias[:, None, :] if has_bias
+          else jnp.zeros((b, 1, 128), jnp.float32))
+    kb_block = block_k if has_bias else 128
+
+    # delta = rowsum(do * out) — a cheap fused reduction outside the kernels.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                  # [B, H, Tq, 1]
+
+    def specs(order):
+        """order: 'qk' (qi then ki in grid) or 'kq'."""
+        if order == "qk":
+            qix, kix = (lambda b, h, qi, ki: (b, h, qi, 0),
+                        lambda b, h, qi, ki: (b, h, ki, 0))
+            rix = lambda b, h, qi, ki: (b, h, qi, 0)
+            bix = ((lambda b, h, qi, ki: (b, 0, ki)) if has_bias
+                   else (lambda b, h, qi, ki: (b, 0, 0)))
+        else:
+            qix, kix = (lambda b, h, ki, qi: (b, h, qi, 0),
+                        lambda b, h, ki, qi: (b, h, ki, 0))
+            rix = lambda b, h, ki, qi: (b, h, qi, 0)
+            bix = ((lambda b, h, ki, qi: (b, 0, ki)) if has_bias
+                   else (lambda b, h, ki, qi: (b, 0, 0)))
+        return [
+            pl.BlockSpec((1, 1, block_q, d), qix),
+            pl.BlockSpec((1, 1, block_k, d), kix),
+            pl.BlockSpec((1, 1, block_k, d), kix),
+            pl.BlockSpec((1, 1, block_q, d), qix),
+            pl.BlockSpec((1, 1, block_q, 1), rix),
+            pl.BlockSpec((1, 1, block_q, 1), rix),
+            pl.BlockSpec((1, 1, kb_block), bix),
+        ], qix, kix
+
+    in_specs, qix, _ = specs("qk")
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          has_bias=has_bias),
+        grid=(b, h, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, d), qix),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, kb)
+
+    in_specs, _, kix = specs("kq")
+    out_specs = [pl.BlockSpec((1, 1, block_k, d), kix),
+                 pl.BlockSpec((1, 1, block_k, d), kix)]
+    out_shape = [jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+                 jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)]
+    scratch = [pltpu.VMEM((block_k, d), jnp.float32),
+               pltpu.VMEM((block_k, d), jnp.float32)]
+    if has_bias:
+        # Per-(batch, head) bias-gradient partials; summed over heads (and
+        # un-scaled) by the caller.
+        out_specs.append(pl.BlockSpec(
+            (1, 1, 1, block_k), lambda b, h, ki, qi: (b, h, 0, ki)))
+        out_shape.append(jax.ShapeDtypeStruct((b, h, 1, tk), jnp.float32))
+        scratch.append(pltpu.VMEM((1, block_k), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          has_bias=has_bias),
+        grid=(b, h, nk, nq),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, kb)
+    if has_bias:
+        dk, dv, db_part = outs
+        dbias = (jnp.sum(db_part[:, :, 0, :], axis=1)
+                 / sm_scale).astype(kbias.dtype)             # [B, S]
+    else:
+        dk, dv = outs
+        dbias = None
+    return dq, dk, dv, dbias
+
+
+# -- custom VJP over the head-major layout -------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kbias, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_pallas(q, k, v, kbias, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, kbias, sm_scale, causal, block_q, block_k,
+                    interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, kbias, sm_scale=sm_scale,
+                                 causal=causal, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
+    return out, (q, k, v, kbias, out, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, kbias, out, lse = res
+    dq, dk, dv, dbias = _flash_bwd_pallas(
+        q, k, v, kbias, out, lse, do, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# -- public API ----------------------------------------------------------------
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    key_padding_bias=None,
+                    block_q: int = _DEFAULT_BLOCK_Q,
+                    block_k: int = _DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """Flash attention.  ``q,k,v``: [batch, seq, heads, head_dim] (the JAX
+    convention of ``apex_tpu.ops.attention``); returns the same shape.
+
+    ``key_padding_bias``: optional additive bias [batch, kv_len] applied to
+    every query row (use ``0`` for visible, large-negative for padded keys).
+    On TPU (or with ``interpret=True``) runs the Pallas kernels; otherwise
+    — or when the sequence doesn't tile — falls back to the jnp blockwise
+    path, which computes the same function.
+    """
+    tq, tk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    use_kernel = ((interpret or _use_pallas()) and bq is not None
+                  and bk is not None and pltpu is not None)
+    if not use_kernel:
+        from .attention import blockwise_attention
+        bias = None
+        if key_padding_bias is not None:
+            bias = key_padding_bias[:, None, None, :]
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   bias=bias)
+
+    qt = q.transpose(0, 2, 1, 3)                         # [B, H, T, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    kb = (None if key_padding_bias is None
+          else key_padding_bias.astype(jnp.float32))
+    out = _flash(qt, kt, vt, kb, float(sm_scale), bool(causal),
+                 int(bq), int(bk), bool(interpret))
+    return out.transpose(0, 2, 1, 3)

@@ -229,10 +229,16 @@ class FusedOptimizer:
             # A grouped optimizer owns subtrees of the model; the i-th model
             # pytree passed by amp.initialize does NOT match the group
             # structure (reference groups are views of the same tensors, so
-            # casting the model suffices there).  Cast each group's own
-            # params with the same policy instead.
+            # casting the model suffices there).  Only accept ``cast_params``
+            # as a per-group list when every element's tree structure matches
+            # the corresponding group — a length-N model pytree that merely
+            # *looks* like a group list must not be mis-wired.  Otherwise
+            # cast each group's own params with the same policy.
+            ts = jax.tree_util.tree_structure
             if (isinstance(cast_params, (list, tuple))
-                    and len(cast_params) == len(self.param_groups)):
+                    and len(cast_params) == len(self.param_groups)
+                    and all(ts(c) == ts(g["params"])
+                            for c, g in zip(cast_params, self.param_groups))):
                 model_groups = list(cast_params)
             else:
                 cast_type = properties.cast_model_type

@@ -203,8 +203,15 @@ def _walk(jaxpr, records: List[OpRecord], scope: str, mult: int):
                 _walk(inner, records, f"{scope}/{name}", mult)
                 continue
         flops, nbytes = _flops_bytes(eqn)
+        # jax.named_scope / prof.scope names land in the equation's
+        # source-info name stack, not in call-primitive params; join them
+        # onto the structural call path so user annotations are visible
+        # (reference traceMarker semantics, pyprof/nvtx/nvmarker.py).
+        ns = getattr(getattr(eqn, "source_info", None), "name_stack", None)
+        ns = str(ns) if ns is not None else ""
+        full_scope = "/".join(p for p in (scope, ns) if p)
         records.append(OpRecord(
-            index=len(records), op=prim, name=scope,
+            index=len(records), op=prim, name=full_scope,
             in_shapes=[tuple(v.aval.shape) for v in eqn.invars
                        if hasattr(v, "aval")],
             in_dtypes=[str(v.aval.dtype) for v in eqn.invars

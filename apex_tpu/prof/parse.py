@@ -160,18 +160,33 @@ def attach_measured(profile, trace: TraceProfile, top: int = 20) -> str:
     analytic FLOPs/bytes next to actual microseconds (the reference's
     final per-op report, ``pyprof/prof/output.py``)."""
     measured = trace.by_op()
-    # expand aliases onto static primitive names
-    joined: Dict[str, dict] = dict(measured)
-    for meas_name, prims in _STATIC_ALIASES.items():
-        if meas_name in measured:
-            for p in prims:
-                joined.setdefault(p, measured[meas_name])
 
     static_by_op: Dict[str, dict] = {}
     for r in profile.records:
         agg = static_by_op.setdefault(r.op, {"flops": 0.0, "bytes": 0.0})
         agg["flops"] += r.flops * r.count
         agg["bytes"] += r.bytes * r.count
+
+    # Expand aliases onto static primitive names.  A measured op that may
+    # cover several static primitives (e.g. HLO "reduce" vs reduce_sum and
+    # reduce_max) has its time *apportioned* by each row's analytic-FLOPs
+    # share (evenly when all shares are zero) so per-op times still sum to
+    # the trace total instead of double-counting.
+    joined: Dict[str, dict] = dict(measured)
+    for meas_name, prims in _STATIC_ALIASES.items():
+        if meas_name not in measured:
+            continue
+        present = [p for p in prims
+                   if p in static_by_op and p not in joined]
+        if not present:
+            continue
+        total_flops = sum(static_by_op[p]["flops"] for p in present)
+        for p in present:
+            share = (static_by_op[p]["flops"] / total_flops
+                     if total_flops else 1.0 / len(present))
+            m = dict(measured[meas_name])
+            m["total_us"] = m.get("total_us", 0.0) * share
+            joined[p] = m
 
     lines = ["{:<24} {:>13} {:>13} {:>11} {:>11}".format(
         "op", "flops", "bytes", "meas_us", "GFLOP/s")]

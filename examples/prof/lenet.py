@@ -1,0 +1,57 @@
+"""prof example 1 — lenet-style model walk-through.
+
+The analog of reference ``apex/pyprof/examples/lenet.py``: instrument a
+small convnet, run the static per-op analysis, print the flops/bytes
+report.  Runs on CPU or TPU:
+
+    python examples/prof/lenet.py
+"""
+
+import os as _os
+import sys as _sys
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), *[_os.pardir] * 2)))
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+
+from apex_tpu import prof
+
+
+class LeNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):                      # x: [N, 32, 32, 1] NHWC
+        with prof.scope("conv1"):
+            x = nn.relu(nn.Conv(6, (5, 5))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        with prof.scope("conv2"):
+            x = nn.relu(nn.Conv(16, (5, 5))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        with prof.scope("classifier"):
+            x = nn.relu(nn.Dense(120)(x))
+            x = nn.relu(nn.Dense(84)(x))
+            return nn.Dense(10)(x)
+
+
+def main():
+    model = LeNet()
+    x = jnp.asarray(np.random.RandomState(0).rand(8, 32, 32, 1), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def fwd(params, x):
+        return model.apply(params, x)
+
+    profile = prof.profile_function(fwd, params, x)
+    print(profile.summary(top=15))
+    print("\ntotal GFLOPs: {:.3f}".format(profile.total_flops / 1e9))
+
+
+if __name__ == "__main__":
+    main()

@@ -307,3 +307,40 @@ def test_initialize_disabled_restores_patches():
     amp.initialize(enabled=False, verbosity=0)
     assert not hasattr(jnp_mod.matmul, "__amp_original__")
     assert not autocast._patched
+
+
+def test_initialize_disabled_passes_lists_through():
+    """enabled=False must return list inputs untouched — not collapse them
+    to their first element (reference _initialize.py:42-56)."""
+    from apex_tpu import amp, optimizers
+
+    m1 = {"w": jnp.ones((2, 2))}
+    m2 = {"w": jnp.zeros((3,))}
+    o1 = optimizers.FusedSGD(m1, lr=0.1)
+    o2 = optimizers.FusedSGD(m2, lr=0.1)
+    models, opts = amp.initialize([m1, m2], [o1, o2], enabled=False,
+                                  verbosity=0)
+    assert isinstance(models, list) and len(models) == 2
+    assert models[0] is m1 and models[1] is m2
+    assert isinstance(opts, list) and opts == [o1, o2]
+
+    # Single objects also pass through unchanged.
+    m, o = amp.initialize(m1, o1, enabled=False, verbosity=0)
+    assert m is m1 and o is o1
+
+
+def test_grouped_amp_wire_rejects_lookalike_model_list():
+    """A model pytree that is a top-level 2-list must not be mis-wired as a
+    per-group cast list for a 2-group optimizer with different structure."""
+    from apex_tpu import amp, optimizers
+
+    groups = [{"params": {"a": jnp.ones((2,))}, "lr": 0.1},
+              {"params": {"b": jnp.ones((3,)), "c": jnp.ones((4,))},
+               "lr": 0.01}]
+    opt = optimizers.FusedSGD(groups, lr=0.1)
+    # model is a list of 2 pytrees whose structures do NOT match the groups
+    lookalike = [{"x": jnp.ones((5,))}, {"y": jnp.ones((6,))}]
+    _, opt = amp.initialize(lookalike, opt, opt_level="O2", verbosity=0)
+    # groups keep their own (cast) structure, not the lookalike's
+    assert set(opt.param_groups[0]["params"].keys()) == {"a"}
+    assert set(opt.param_groups[1]["params"].keys()) == {"b", "c"}

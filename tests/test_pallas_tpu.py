@@ -165,3 +165,68 @@ def test_xentropy_end_to_end_grad_on_chip():
                                atol=1e-5, rtol=1e-4)
     # padded row contributes zero gradient
     assert np.allclose(np.asarray(grad_k)[0], 0.0)
+
+# -- flash attention kernels --------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_flash_attention_fwd_on_chip(causal, dtype):
+    from apex_tpu.ops.attention import dot_product_attention
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(6)
+    B, T, H, D = 2, 512, 4, 64
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), dtype) for _ in range(3))
+
+    with jax.default_device(_tpu_dev()):
+        out = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=256, block_k=256))(q, k, v)
+    ref = dot_product_attention(q.astype(jnp.float32),
+                                k.astype(jnp.float32),
+                                v.astype(jnp.float32), causal=causal)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 2e-4
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=tol, rtol=tol)
+
+
+def test_flash_attention_bias_on_chip():
+    from apex_tpu.ops.attention import dot_product_attention
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(7)
+    B, T, H, D = 2, 384, 2, 64
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+               for _ in range(3))
+    valid = jnp.arange(T)[None, :] < jnp.array([300, 128])[:, None]
+    kb = jnp.where(valid, 0.0, -1e9)
+
+    with jax.default_device(_tpu_dev()):
+        out = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, key_padding_bias=kb, block_q=128, block_k=128))(q, k, v)
+    ref = dot_product_attention(q, k, v, bias=kb[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads_on_chip(causal):
+    from apex_tpu.ops.attention import dot_product_attention
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(8)
+    B, T, H, D = 1, 256, 2, 64
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+               for _ in range(3))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+    with jax.default_device(_tpu_dev()):
+        g_k = jax.jit(jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=128, block_k=128)),
+            argnums=(0, 1, 2)))(q, k, v)
+    g_r = jax.grad(loss(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_k, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
