@@ -57,10 +57,12 @@ _DEFAULT_BLOCK_K = 512
 
 
 def _pick_block(t: int, preferred: int) -> Optional[int]:
-    """Largest block <= preferred that divides t and is a multiple of 128
-    (or t itself when t <= preferred — sublanes pad internally)."""
+    """Largest block <= preferred that divides t and is a multiple of 128;
+    or t itself when t <= preferred and sublane-aligned (t % 8 == 0 — a
+    whole-array block equal to the array dim is legal in Mosaic).  None =
+    no legal block, caller falls back to the jnp path."""
     if t <= preferred:
-        return t
+        return t if t % 8 == 0 else None
     for blk in range(preferred, 127, -128):
         if t % blk == 0:
             return blk

@@ -43,6 +43,10 @@ class KernelRecord(NamedTuple):
     start_us: float
     run_id: str            # one executable launch == one step
     device: str
+    # Device-event format extras (TPU traces; zero/empty on CPU traces):
+    category: str = ""     # XLA hlo_category, e.g. "convolution fusion"
+    model_flops: float = 0.0
+    bytes_accessed: float = 0.0
 
 
 _WRAP_RE = re.compile(r"^(?:wrapped_|fusion_)?(.*?)(?:\.\d+)?$")
@@ -82,6 +86,26 @@ class TraceProfile:
             agg["mean_us"] = agg["total_us"] / agg["count"]
         return out
 
+    def by_category(self) -> Dict[str, dict]:
+        """Aggregate per XLA ``hlo_category`` (TPU device-event traces):
+        measured time, XLA-attributed model FLOPs and bytes, and the
+        achieved TFLOP/s while that category was running.  Empty for
+        CPU-style traces (which carry no category)."""
+        out: Dict[str, dict] = {}
+        for r in self.records:
+            if not r.category:
+                continue
+            agg = out.setdefault(r.category, {
+                "count": 0, "total_us": 0.0, "flops": 0.0, "bytes": 0.0})
+            agg["count"] += 1
+            agg["total_us"] += r.duration_us
+            agg["flops"] += r.model_flops
+            agg["bytes"] += r.bytes_accessed
+        for agg in out.values():
+            agg["tflops_per_sec"] = (agg["flops"] / agg["total_us"] / 1e6
+                                     if agg["total_us"] else 0.0)
+        return out
+
     def steps(self) -> Dict[str, float]:
         """Wall time per ``run_id`` (one executable launch = one step) —
         the kernel↔iteration association of the reference parse stage."""
@@ -101,6 +125,16 @@ class TraceProfile:
         for name, agg in rows[:top]:
             lines.append("{:<28} {:>7} {:>12.1f} {:>12.2f}".format(
                 name, agg["count"], agg["total_us"], agg["mean_us"]))
+        cats = self.by_category()
+        if cats:
+            lines.append("")
+            lines.append("{:<28} {:>7} {:>12} {:>12}".format(
+                "hlo_category", "count", "total_us", "TFLOP/s"))
+            for name, agg in sorted(cats.items(),
+                                    key=lambda kv: -kv[1]["total_us"])[:top]:
+                lines.append("{:<28} {:>7} {:>12.1f} {:>12.1f}".format(
+                    name, agg["count"], agg["total_us"],
+                    agg["tflops_per_sec"]))
         lines.append(f"TOTAL measured: {self.total_us:.1f} us over "
                      f"{len(self.steps())} step(s)")
         return "\n".join(lines)
@@ -127,19 +161,40 @@ def parse_trace(logdir: str, module_filter: Optional[str] = None
                 continue
             args = e.get("args") or {}
             hlo_op = args.get("hlo_op")
-            if not hlo_op:
-                continue
-            module = args.get("hlo_module", "")
-            if module_filter and module_filter not in module:
-                continue
-            records.append(KernelRecord(
-                name=hlo_op,
-                base_op=_normalize(hlo_op),
-                hlo_module=module,
-                duration_us=float(e.get("dur", 0.0)),
-                start_us=float(e.get("ts", 0.0)),
-                run_id=str(args.get("run_id", "")),
-                device=str(args.get("device_ordinal", ""))))
+            if hlo_op:
+                # CPU/GPU-style trace: per-op events with hlo_op/hlo_module.
+                module = args.get("hlo_module", "")
+                if module_filter and module_filter not in module:
+                    continue
+                records.append(KernelRecord(
+                    name=hlo_op,
+                    base_op=_normalize(hlo_op),
+                    hlo_module=module,
+                    duration_us=float(e.get("dur", 0.0)),
+                    start_us=float(e.get("ts", 0.0)),
+                    run_id=str(args.get("run_id", "")),
+                    device=str(args.get("device_ordinal", ""))))
+            elif "hlo_category" in args:
+                # TPU device-event format: the event NAME is the HLO
+                # instruction ("convert_reduce_fusion.12"), args carry
+                # hlo_category / model_flops / bytes_accessed (the CUPTI
+                # kernel-record analog on real chips).  No run_id — step
+                # segmentation is unavailable — and no hlo_module either,
+                # so ``module_filter`` is ignored here rather than matched
+                # against instruction names (which would silently drop
+                # every event).
+                name = str(e.get("name", ""))
+                records.append(KernelRecord(
+                    name=name,
+                    base_op=_normalize(name),
+                    hlo_module="",
+                    duration_us=float(e.get("dur", 0.0)),
+                    start_us=float(e.get("ts", 0.0)),
+                    run_id="",
+                    device=str(e.get("pid", "")),
+                    category=str(args.get("hlo_category", "")),
+                    model_flops=float(args.get("model_flops") or 0.0),
+                    bytes_accessed=float(args.get("bytes_accessed") or 0.0)))
     records.sort(key=lambda r: r.start_us)
     return TraceProfile(records)
 

@@ -60,6 +60,29 @@ def _pmean_varying(x, axis_name):
     return x
 
 
+def _por_varying(flag, axis_name):
+    """Logical OR of a bool scalar over the mesh axes it varies on.  With
+    tensor-parallel (sharded) gradients each shard sees only its slice, so
+    the overflow flag must be agreed mesh-wide or the scaler state — and
+    then the parameters — would diverge across ranks.
+
+    Under shard_map the flag's vma names EVERY axis it varies on — e.g.
+    "tp" even when the caller only reduces grads over ("data",) — so the
+    vma, when available, wins over ``axis_name``.  Without vma the
+    ``axis_name`` list is used as-is: psum of an already-replicated flag
+    over an extra axis is ``n * flag``, and the ``> 0`` turns either form
+    into the OR.
+    """
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    try:
+        names = tuple(jax.typeof(flag).vma)
+    except AttributeError:
+        pass
+    if names:
+        return jax.lax.psum(flag.astype(jnp.int32), names) > 0
+    return flag
+
+
 class FunctionalOptimizer(NamedTuple):
     init: Callable
     update: Callable      # (grads, state, params, lr, grad_scale, apply_mask)
@@ -181,6 +204,11 @@ def make_train_step(loss_fn: Callable,
                 axis_index_groups=axis_index_groups)
 
         grads, scaler_state = scaler.unscale(grads, state.scaler)
+        if dynamic and axis_name is not None:
+            # Sharded (e.g. tensor-parallel) grads: agree on overflow
+            # mesh-wide so every rank skips (or steps) together.
+            scaler_state = scaler_state._replace(
+                overflow=_por_varying(scaler_state.overflow, axis_name))
         if dynamic:
             apply_mask = jnp.logical_not(scaler_state.overflow)
         else:

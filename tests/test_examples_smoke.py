@@ -65,3 +65,11 @@ def test_distributed_example(monkeypatch):
     _run_example(monkeypatch,
                  "examples/simple/distributed/distributed_data_parallel.py",
                  [])
+
+
+@pytest.mark.parametrize("name", ["lenet", "user_annotation",
+                                  "custom_func_module", "end_to_end"])
+def test_prof_examples(monkeypatch, name, tmp_path):
+    """The pyprof-examples analog (reference apex/pyprof/examples/)."""
+    argv = [str(tmp_path / "trace")] if name == "end_to_end" else []
+    _run_example(monkeypatch, f"examples/prof/{name}.py", argv)

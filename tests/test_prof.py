@@ -164,3 +164,39 @@ def test_parse_trace_missing_dir_raises(tmp_path):
     from apex_tpu import prof as P
     with pytest.raises(FileNotFoundError):
         P.parse_trace(str(tmp_path / "nope"))
+
+
+def test_parse_trace_tpu_device_event_format(tmp_path):
+    import json
+    """TPU traces carry hlo_category/model_flops device events (no hlo_op
+    arg); the parse stage must ingest them (discovered live on the axon
+    v5e trace — reference kernel-record parity for real chips)."""
+    import gzip
+
+    from apex_tpu import prof as P
+
+    run = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    run.mkdir(parents=True)
+    events = [
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 10.0, "dur": 100.0,
+         "name": "convert_reduce_fusion.7",
+         "args": {"hlo_category": "convolution fusion",
+                  "model_flops": "2000000", "bytes_accessed": "4096"}},
+        {"ph": "X", "pid": 3, "tid": 3, "ts": 120.0, "dur": 50.0,
+         "name": "multiply_subtract_fusion.2",
+         "args": {"hlo_category": "loop fusion",
+                  "model_flops": "1000", "bytes_accessed": "2048"}},
+        {"ph": "M", "name": "process_name"},          # metadata: ignored
+        {"ph": "X", "ts": 1.0, "dur": 1.0, "name": "no_args_event"},
+    ]
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    tp = P.parse_trace(str(tmp_path))
+    assert len(tp.records) == 2
+    by_op = tp.by_op()
+    assert by_op["convert_reduce_fusion"]["total_us"] == 100.0
+    cats = tp.by_category()
+    assert cats["convolution fusion"]["count"] == 1
+    assert abs(cats["convolution fusion"]["tflops_per_sec"] - 0.02) < 1e-9
+    assert "hlo_category" in tp.summary()

@@ -1,0 +1,141 @@
+"""Tensor-parallel layers: sharded compute == unsharded oracle.
+
+Beyond-parity (reference is DP-only, SURVEY.md §2.10): Megatron-style
+column/row-parallel matmuls over a mesh axis, validated on the virtual
+CPU mesh the way the reference validates SyncBN against the whole-batch
+oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel import (column_parallel_dense, row_parallel_dense,
+                               shard_column, shard_row, tp_mlp,
+                               tp_self_attention)
+
+
+@pytest.fixture
+def tp_mesh():
+    return Mesh(np.array(jax.devices("cpu")[:4]), ("tp",))
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape) * 0.1,
+                       jnp.float32)
+
+
+def test_column_row_pair_matches_dense(tp_mesh):
+    x = _rand((8, 64), 0)
+    w1 = _rand((64, 128), 1)
+    b1 = _rand((128,), 2)
+    w2 = _rand((128, 64), 3)
+    b2 = _rand((64,), 4)
+
+    def sharded(x, w1, b1, w2, b2):
+        h = column_parallel_dense(x, w1, b1)
+        return row_parallel_dense(h, w2, "tp", b=b2)
+
+    y = jax.jit(shard_map(
+        sharded, mesh=tp_mesh,
+        in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None), P()),
+        out_specs=P()))(x, w1, b1, w2, b2)
+    ref = (x @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_tp_mlp_matches_dense(tp_mesh):
+    x = _rand((4, 16, 64), 0)
+    w1, b1 = _rand((64, 256), 1), _rand((256,), 2)
+    w2, b2 = _rand((256, 64), 3), _rand((64,), 4)
+
+    y = jax.jit(shard_map(
+        lambda x, w1, b1, w2, b2: tp_mlp(x, w1, b1, w2, b2, "tp"),
+        mesh=tp_mesh,
+        in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None), P()),
+        out_specs=P()))(x, w1, b1, w2, b2)
+    ref = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_tp_self_attention_matches_dense(tp_mesh):
+    from apex_tpu.ops.attention import blockwise_attention
+
+    B, T, D, H, E = 2, 16, 32, 4, 8
+    x = _rand((B, T, D), 0)
+    wqkv = _rand((D, 3, H, E), 1)
+    wo = _rand((H * E, D), 2)
+
+    def sharded(x, wqkv, wo):
+        return tp_self_attention(x, wqkv, wo, H // 4, "tp", causal=True)
+
+    y = jax.jit(shard_map(
+        sharded, mesh=tp_mesh,
+        in_specs=(P(), P(None, None, "tp"), P("tp", None)),
+        out_specs=P()))(x, wqkv, wo)
+
+    qkv = jnp.einsum("btd,dche->btche", x, wqkv)
+    ctx = blockwise_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                              causal=True)
+    ref = ctx.reshape(B, T, -1) @ wo
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_shard_helpers_roundtrip(tp_mesh):
+    w = _rand((32, 64), 5)
+
+    def get_col(w):
+        return shard_column(w, "tp")
+
+    cols = jax.jit(shard_map(get_col, mesh=tp_mesh, in_specs=(P(),),
+                             out_specs=P("tp")))(w)
+    # gathering the shards along the split axis reconstructs w
+    np.testing.assert_array_equal(
+        np.asarray(cols).reshape(4, 32, 16).transpose(1, 0, 2).reshape(32, 64),
+        np.asarray(w))
+
+    def get_row(w):
+        return shard_row(w, "tp")
+
+    rows = jax.jit(shard_map(get_row, mesh=tp_mesh, in_specs=(P(),),
+                             out_specs=P("tp")))(w)
+    np.testing.assert_array_equal(np.asarray(rows), np.asarray(w))
+
+
+def test_tp_gradients_stay_local_and_match(tp_mesh):
+    """Backprop through a column->row pair: each shard's weight grads equal
+    the corresponding slice of the dense-model grads (no collective needed
+    for TP weight grads — the Megatron property)."""
+    x = _rand((8, 64), 0)
+    w1 = _rand((64, 128), 1)
+    w2 = _rand((128, 64), 3)
+
+    def loss_sharded(x, w1, w2):
+        h = column_parallel_dense(x, w1)
+        y = row_parallel_dense(h, w2, "tp")
+        return jnp.sum(y ** 2) / y.size
+
+    def run(x, w1, w2):
+        return jax.grad(loss_sharded, argnums=(1, 2))(x, w1, w2)
+
+    g1, g2 = jax.jit(shard_map(
+        run, mesh=tp_mesh,
+        in_specs=(P(), P(None, "tp"), P("tp", None)),
+        out_specs=(P(None, "tp"), P("tp", None))))(x, w1, w2)
+
+    def loss_dense(x, w1, w2):
+        y = (x @ w1) @ w2
+        return jnp.sum(y ** 2) / y.size
+
+    r1, r2 = jax.grad(loss_dense, argnums=(1, 2))(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(r1),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(r2),
+                               atol=1e-5, rtol=1e-5)
