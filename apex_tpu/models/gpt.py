@@ -1,0 +1,94 @@
+"""GPT-style decoder-only causal LM (flax, TPU-first).
+
+Beyond-parity model family (the reference ships no model code): the
+long-context training model that exercises the framework's causal flash
+attention (``apex_tpu/ops/flash_attention.py``), FusedLayerNorm, the
+fused xentropy loss and — through ``attention_impl="ring"`` — sequence
+parallelism.  Pre-LN residual blocks, learned positions, weight-tied LM
+head; bf16 matmuls with fp32 softmax/norm/loss (the O1 cast-list split,
+hard-wired where it matters).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..normalization import FusedLayerNorm
+
+
+class GPTBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "flash"
+    sp_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = FusedLayerNorm(normalized_shape=d, name="ln1")(x).astype(x.dtype)
+        from .bert import BertSelfAttention
+        h = BertSelfAttention(self.num_heads, self.dtype,
+                              attention_impl=self.attention_impl,
+                              sp_axis=self.sp_axis, causal=True,
+                              name="attention")(h)
+        x = x + h
+        h = FusedLayerNorm(normalized_shape=d, name="ln2")(x).astype(x.dtype)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(h)
+        h = nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
+        h = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_down")(h)
+        return x + h
+
+
+class GPT(nn.Module):
+    """Decoder-only LM.  ``__call__(input_ids) -> logits [B, T, V]`` (fp32,
+    weight-tied to the token embedding)."""
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.float32
+    attention_impl: str = "flash"   # full | blockwise | flash | ring | ulysses
+    sp_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, input_ids):
+        b, t = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (self.vocab_size, self.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (self.max_len, self.hidden_size), jnp.float32)
+        pos = jnp.arange(t)
+        if self.sp_axis is not None:
+            # Sequence-sharded: this shard's global positions.
+            pos = pos + jax.lax.axis_index(self.sp_axis) * t
+        x = (wte[input_ids] + wpe[pos][None]).astype(self.dtype)
+        for i in range(self.num_layers):
+            x = GPTBlock(self.num_heads, self.mlp_dim, self.dtype,
+                         attention_impl=self.attention_impl,
+                         sp_axis=self.sp_axis, name=f"block_{i}")(x)
+        x = FusedLayerNorm(normalized_shape=self.hidden_size,
+                           name="ln_f")(x)
+        return (x.astype(jnp.float32) @ wte.T).astype(jnp.float32)
+
+
+def gpt2_small(**kw):
+    return GPT(**kw)
+
+
+def gpt_tiny(**kw):
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 256)
+    kw.setdefault("max_len", 256)
+    return GPT(**kw)

@@ -21,6 +21,8 @@ from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 from .tensor_parallel import (column_parallel_dense,  # noqa: F401
                               row_parallel_dense, tp_mlp,
                               tp_self_attention, shard_column, shard_row)
+from .pipeline import spmd_pipeline, stack_stage_params  # noqa: F401
+from .expert_parallel import moe_layer, MoEAux  # noqa: F401
 
 
 def convert_syncbn_model(module: nn.Module, axis_name: str = "data",

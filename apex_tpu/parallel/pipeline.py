@@ -1,0 +1,115 @@
+"""Pipeline parallelism — SPMD collective-permute pipeline over a mesh axis.
+
+Beyond-parity scope (the reference implements data parallelism only,
+SURVEY.md §2.10).  The TPU-idiomatic pipeline is NOT a scheduler with
+per-stage processes (the GPU pattern): it is ONE SPMD program in which
+
+* each ``pp`` rank holds one stage's parameters (a ``[n_stages, ...]``
+  stacked pytree sharded on the leading axis),
+* a ``lax.scan`` over ``n_stages + n_microbatches - 1`` clock ticks runs
+  every stage every tick, rotating activations to the next rank with a
+  single ``ppermute`` per tick (riding the ICI ring),
+* stage 0 injects microbatch ``t`` and the last stage collects output
+  ``t - (n_stages-1)``; off-schedule positions compute on don't-care data
+  that the output select masks out, so their gradients are exactly zero,
+* the BACKWARD schedule is not hand-written at all: differentiating the
+  scan reverses it, and the transpose of ``ppermute`` is the reverse
+  rotation — jax.grad through ``spmd_pipeline`` IS the reverse pipeline.
+
+This trades the classic pipeline bubble (every rank computes every tick)
+for compiler-visible regularity — the standard SPMD pipelining recipe on
+TPU meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _rotate(x, axis_name: str):
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, x, *,
+                  axis_name: str, num_microbatches: int):
+    """Run ``x`` through ``n_stages`` chained applications of ``stage_fn``,
+    pipelined over the ``axis_name`` mesh axis.
+
+    Call inside ``shard_map``.  Arguments:
+
+    * ``stage_fn(params_i, h) -> h`` — one stage; applied by rank ``i``
+      with its own parameters.  Activation shapes must be identical across
+      stages (the homogeneous-stack restriction of scan-over-layers).
+    * ``stage_params`` — this rank's slice of the ``[n_stages, ...]``
+      stacked parameter pytree (shard the stack with ``P("pp")``); leading
+      axis of length 1 is squeezed.
+    * ``x`` — ``[batch, ...]`` input, replicated over the pp axis;
+      split into ``num_microbatches`` along the batch dim.
+
+    Returns ``[batch, ...]`` outputs, replicated over the pp axis.
+    """
+    n_stages = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    params_i = jax.tree_util.tree_map(
+        lambda p: jnp.squeeze(p, axis=0) if p.shape[0] == 1 else p,
+        stage_params)
+
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"num_microbatches {num_microbatches}")
+    mb = batch // num_microbatches
+    micro = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+    ticks = n_stages + num_microbatches - 1
+    # The scan carry varies per pp rank from tick 1 on; mark the zero
+    # initializers as axis-varying so the carry type is stable under
+    # shard_map's vma checking.
+    def _pvary(v):
+        try:
+            return lax.pcast(v, (axis_name,), to="varying")
+        except (AttributeError, TypeError):  # older jax spelling
+            return lax.pvary(v, (axis_name,))
+    buf0 = _pvary(jnp.zeros_like(micro[0]))
+    out0 = _pvary(jnp.zeros_like(micro))
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 ingests microbatch t (clamped; off-schedule data is
+        # masked out at collection)
+        feed = lax.dynamic_index_in_dim(
+            micro, jnp.minimum(t, num_microbatches - 1), keepdims=False)
+        h_in = jnp.where(idx == 0, feed, buf)
+        h_out = stage_fn(params_i, h_in)
+        # last stage collects microbatch m = t - (n_stages - 1)
+        m = t - (n_stages - 1)
+        is_last = idx == n_stages - 1
+        valid = jnp.logical_and(is_last, m >= 0)
+        slot = jnp.clip(m, 0, num_microbatches - 1)
+        cur = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+        upd = jnp.where(valid, h_out, cur)
+        outs = lax.dynamic_update_index_in_dim(outs, upd, slot, axis=0)
+        # rotate activations to the next stage for the next tick
+        buf = _rotate(h_out, axis_name)
+        return (buf, outs), None
+
+    (_, outs), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+
+    # Outputs live on the last rank; replicate them over the pp axis so the
+    # loss (and its gradient path) is identical on every rank.
+    outs = lax.psum(jnp.where(idx == n_stages - 1, outs, 0.0), axis_name)
+    return outs.reshape(batch, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage parameter pytrees into the ``[n_stages,
+    ...]`` pytree ``spmd_pipeline`` expects (shard its leading axis over
+    the pp mesh axis with ``P("pp")``)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)

@@ -167,10 +167,10 @@ def test_parse_trace_missing_dir_raises(tmp_path):
 
 
 def test_parse_trace_tpu_device_event_format(tmp_path):
-    import json
     """TPU traces carry hlo_category/model_flops device events (no hlo_op
     arg); the parse stage must ingest them (discovered live on the axon
     v5e trace — reference kernel-record parity for real chips)."""
+    import json
     import gzip
 
     from apex_tpu import prof as P
