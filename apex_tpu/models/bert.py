@@ -26,8 +26,9 @@ class BertSelfAttention(nn.Module):
     ``"flash"`` (the Pallas TPU kernel of
     ``apex_tpu/ops/flash_attention.py``; falls back to blockwise off-TPU),
     ``"ring"`` (ring attention over sequence shards — call inside
-    shard_map with the sequence split over ``sp_axis``), or ``"ulysses"``
-    (all-to-all head resharding).  Ring/Ulysses are the long-context
+    shard_map with the sequence split over ``sp_axis``), ``"ring_flash"``
+    (ring attention with the Pallas flash kernels as the local op), or
+    ``"ulysses"`` (all-to-all head resharding).  Ring/Ulysses are the long-context
     paths; they take the padding mask only via causal=False
     full-visibility (use blockwise/flash bias for padding within a
     shard-local setting).
@@ -48,15 +49,17 @@ class BertSelfAttention(nn.Module):
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
-        if self.attention_impl in ("ring", "ulysses"):
+        if self.attention_impl in ("ring", "ring_flash", "ulysses"):
             if mask is not None:
                 raise ValueError(
                     "ring/ulysses attention paths take no padding mask; pad "
                     "to shard boundaries or use attention_impl='blockwise'")
             from ..parallel.ring_attention import (ring_attention,
+                                                   ring_flash_attention,
                                                    ulysses_attention)
-            fn = (ring_attention if self.attention_impl == "ring"
-                  else ulysses_attention)
+            fn = {"ring": ring_attention,
+                  "ring_flash": ring_flash_attention,
+                  "ulysses": ulysses_attention}[self.attention_impl]
             ctx = fn(q, k, v, self.sp_axis, causal=self.causal)
         elif self.attention_impl == "flash":
             from ..ops.flash_attention import flash_attention

@@ -69,9 +69,12 @@ def _pick_block(t: int, preferred: int) -> Optional[int]:
     return None
 
 
-def _causal_block_mask(qi, ki, bq, bk):
-    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+def _causal_block_mask(qi, ki, bq, bk, q_off=0, k_off=0):
+    """Causal mask on GLOBAL positions: ``q_off``/``k_off`` are the global
+    offsets of this call's first query/key row (dynamic scalars under ring
+    attention, 0 for single-device use)."""
+    q_pos = q_off + qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_off + ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return q_pos >= k_pos
 
 
@@ -98,7 +101,8 @@ def _mm(a, b, dims):
 
 # -- forward kernel ------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, out_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, qoff_ref, koff_ref,
+                out_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, causal, has_bias):
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -112,8 +116,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, out_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: fully-masked KV blocks above the diagonal are skipped.
-    run = (qi * bq + bq - 1 >= ki * bk) if causal else True
+    # Causal: fully-masked KV blocks above the diagonal are skipped (on
+    # global positions, so a ring shard entirely in the future runs no
+    # block at all).
+    if causal:
+        q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
+        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+    else:
+        q_off = k_off = 0
+        run = True
 
     @_when(run)
     def _():
@@ -123,7 +134,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, out_ref, lse_ref,
         if has_bias:
             s = s + kb_ref[0].astype(jnp.float32)
         if causal:
-            mask = _causal_block_mask(qi, ki, bq, bk)
+            mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off)
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]                                # [bq, 1]
         l_prev = l_scr[:]
@@ -148,9 +159,42 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, out_ref, lse_ref,
                                   m_scr[:] + jnp.log(safe))
 
 
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct whose vma (varying-manual-axes) is the union of the
+    operands' — required for pallas_call outputs inside shard_map with
+    check_vma=True; harmless (empty vma) outside."""
+    vma = None
+    for x in like:
+        try:
+            v = jax.typeof(x).vma
+        except AttributeError:
+            continue
+        vma = v if vma is None else (vma | v)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:       # older jax: no vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _off_arg(offset):
+    """Dynamic global-offset scalar as a (1, 1) SMEM operand."""
+    return jnp.asarray(offset, jnp.int32).reshape(1, 1)
+
+
+def _off_spec():
+    if pltpu is None:  # pragma: no cover
+        return pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0))
+    return pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+
 def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
-                      interpret=False):
+                      q_offset=0, k_offset=0, interpret=False):
     """q,k,v: [B, H, T, D] (head-major).  kbias: [B, S] or None.
+    ``q_offset``/``k_offset``: global positions of the first query/key row
+    (may be traced scalars — the ring-attention hook).
     Returns (out [B,H,T,D], lse [B,H,T,1] fp32)."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -172,14 +216,16 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, 1, kb_block),
                          (lambda b, h, qi, ki: (b, 0, ki)) if has_bias
                          else (lambda b, h, qi, ki: (b, 0, 0))),
+            _off_spec(),
+            _off_spec(),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+            _sds((b, h, tq, d), q.dtype, q, k, v),
+            _sds((b, h, tq, 1), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -187,14 +233,14 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, kb)
+    )(q, k, v, kb, _off_arg(q_offset), _off_arg(k_offset))
     return out, lse
 
 
 # -- backward kernels ----------------------------------------------------------
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
-                    qi, ki, *, sm_scale, causal, has_bias):
+                    qi, ki, q_off, k_off, *, sm_scale, causal, has_bias):
     """Shared bwd recompute: returns (p, ds), both [bq, bk] fp32."""
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
@@ -204,7 +250,7 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
     if has_bias:
         s = s + kb_ref[0].astype(jnp.float32)
     if causal:
-        mask = _causal_block_mask(qi, ki, bq, bk)
+        mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off)
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse_ref[0, 0])                           # lse: [bq, 1]
     dp = _mm(do_ref[0, 0], v_ref[0, 0], ((1,), (1,)))        # [bq, bk]
@@ -213,6 +259,7 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+                   qoff_ref, koff_ref,
                    dq_ref, dq_scr, *, sm_scale, causal, has_bias):
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -223,12 +270,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (qi * bq + bq - 1 >= ki * bk) if causal else True
+    if causal:
+        q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
+        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+    else:
+        q_off = k_off = 0
+        run = True
 
     @_when(run)
     def _():
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                                delta_ref, kb_ref, qi, ki, sm_scale=sm_scale,
+                                delta_ref, kb_ref, qi, ki, q_off, k_off,
+                                sm_scale=sm_scale,
                                 causal=causal, has_bias=has_bias)
         dq_scr[:] = dq_scr[:] + _mm(ds.astype(k_ref.dtype), k_ref[0, 0],
                                     ((1,), (0,)))
@@ -239,6 +292,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+                    qoff_ref, koff_ref,
                     *refs, sm_scale, causal, has_bias):
     if has_bias:
         dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr = refs
@@ -257,12 +311,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
         if has_bias:
             db_scr[:] = jnp.zeros_like(db_scr)
 
-    run = (qi * bq + bq - 1 >= ki * bk) if causal else True
+    if causal:
+        q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
+        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+    else:
+        q_off = k_off = 0
+        run = True
 
     @_when(run)
     def _():
         p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                                delta_ref, kb_ref, qi, ki, sm_scale=sm_scale,
+                                delta_ref, kb_ref, qi, ki, q_off, k_off,
+                                sm_scale=sm_scale,
                                 causal=causal, has_bias=has_bias)
         do = do_ref[0, 0]
         # K-major outputs via leading-dim contraction — no transposes.
@@ -285,7 +345,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 
 
 def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
-                      block_q, block_k, interpret=False):
+                      block_q, block_k, q_offset=0, k_offset=0,
+                      delta=None, interpret=False):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
@@ -293,10 +354,15 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
     kb = (kbias[:, None, :] if has_bias
           else jnp.zeros((b, 1, 128), jnp.float32))
     kb_block = block_k if has_bias else 128
+    qoff, koff = _off_arg(q_offset), _off_arg(k_offset)
 
-    # delta = rowsum(do * out) — a cheap fused reduction outside the kernels.
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)                  # [B, H, Tq, 1]
+    if delta is None:
+        # delta = rowsum(do * out) — a cheap fused reduction outside the
+        # kernels; ring attention passes it in precomputed (do/out are
+        # step-invariant there, so per-step recompute would be waste
+        # inside the scan).
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)              # [B, H, Tq, 1]
 
     def specs(order):
         """order: 'qk' (qi then ki in grid) or 'kq'."""
@@ -320,6 +386,8 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
             pl.BlockSpec((1, 1, block_q, 1), rix),
             pl.BlockSpec((1, 1, block_q, 1), rix),
             pl.BlockSpec((1, 1, kb_block), bix),
+            _off_spec(),
+            _off_spec(),
         ], qix, kix
 
     in_specs, qix, _ = specs("qk")
@@ -329,16 +397,16 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         grid=(b, h, nq, nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d), qix),
-        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        out_shape=_sds((b, h, tq, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, kb)
+    )(q, k, v, do, lse, delta, kb, qoff, koff)
 
     in_specs, _, kix = specs("kq")
     out_specs = [pl.BlockSpec((1, 1, block_k, d), kix),
                  pl.BlockSpec((1, 1, block_k, d), kix)]
-    out_shape = [jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
-                 jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)]
+    out_shape = [_sds((b, h, tk, d), k.dtype, q, k, v, do),
+                 _sds((b, h, tk, d), v.dtype, q, k, v, do)]
     scratch = [pltpu.VMEM((block_k, d), jnp.float32),
                pltpu.VMEM((block_k, d), jnp.float32)]
     if has_bias:
@@ -346,7 +414,7 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         # un-scaled) by the caller.
         out_specs.append(pl.BlockSpec(
             (1, 1, 1, block_k), lambda b, h, ki, qi: (b, h, 0, ki)))
-        out_shape.append(jax.ShapeDtypeStruct((b, h, 1, tk), jnp.float32))
+        out_shape.append(_sds((b, h, 1, tk), jnp.float32, q, k, v, do))
         scratch.append(pltpu.VMEM((1, block_k), jnp.float32))
     outs = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
@@ -357,7 +425,7 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v, do, lse, delta, kb)
+    )(q, k, v, do, lse, delta, kb, qoff, koff)
     if has_bias:
         dk, dv, db_part = outs
         dbias = (jnp.sum(db_part[:, :, 0, :], axis=1)

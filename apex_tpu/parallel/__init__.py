@@ -17,7 +17,8 @@ from .distributed import (DistributedDataParallel, Reducer,  # noqa: F401
                           reduce_gradients, broadcast_params)
 from .sync_batchnorm import SyncBatchNorm, welford_parallel  # noqa: F401
 from .LARC import LARC, larc_transform, larc_gradients       # noqa: F401
-from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .ring_attention import (ring_attention,  # noqa: F401
+                             ring_flash_attention, ulysses_attention)
 from .tensor_parallel import (column_parallel_dense,  # noqa: F401
                               row_parallel_dense, tp_mlp,
                               tp_self_attention, shard_column, shard_row)

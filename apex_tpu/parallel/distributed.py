@@ -41,6 +41,23 @@ def _is_float(x):
                                                   jnp.floating)
 
 
+def vma_tracking_live(axis_name) -> bool:
+    """Whether varying-manual-axes tracking is live on this trace.
+
+    Under ``shard_map(check_vma=False)`` every aval reports an empty vma
+    set, which must NOT be read as "already reduced"/"replicated" — there
+    the implicit-broadcast transpose does not insert a psum either, so
+    grads arrive per-shard.  ``axis_index`` is axis-varying by
+    construction, so it probes tracking.  Shared by the gradient
+    reduction here, the overflow agreement in ``training._por_varying``,
+    and the ring-flash dispatch.
+    """
+    try:
+        return axis_name in jax.typeof(lax.axis_index(axis_name)).vma
+    except Exception:
+        return False
+
+
 def group_psum(x, axis_name: str, axis_index_groups=None):
     """``psum`` over ``axis_name``, optionally restricted to rank sub-groups.
 
@@ -135,16 +152,7 @@ def reduce_gradients(grads,
         if axis_index_groups:
             world_size = len(axis_index_groups[0])
 
-    # Whether varying-manual-axes tracking is live on this trace: under
-    # shard_map(check_vma=False) every aval reports an empty vma set, which
-    # must NOT be read as "already reduced" — there the implicit-broadcast
-    # transpose does not insert a psum either, so grads arrive per-shard.
-    # axis_index is axis-varying by construction, so it probes tracking.
-    try:
-        _vma_tracking = axis_names[0] in jax.typeof(
-            lax.axis_index(axis_names[0])).vma
-    except Exception:
-        _vma_tracking = False
+    _vma_tracking = vma_tracking_live(axis_names[0])
 
     def _already_reduced(g) -> bool:
         """shard_map autodiff inserts the psum itself when differentiating

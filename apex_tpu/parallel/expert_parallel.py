@@ -20,6 +20,7 @@ lax.axis_size(axis_name)``).
 
 from __future__ import annotations
 
+import math
 from typing import Callable, NamedTuple
 
 import jax
@@ -66,7 +67,7 @@ def moe_layer(x, router_w, expert_fn: Callable, expert_params, *,
             f"'{axis_name}' axis has {n_experts} ranks — this layer places "
             f"exactly one expert per rank")
     t_local, d = x.shape
-    capacity = max(1, int(t_local * capacity_factor / n_experts + 0.999))
+    capacity = max(1, math.ceil(t_local * capacity_factor / n_experts))
 
     logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)                      # [T, E]

@@ -106,6 +106,161 @@ def ring_attention(q, k, v, axis_name: str, *,
     return finalize_attention(m, l, acc, q.dtype)
 
 
+from .distributed import vma_tracking_live as _vma_tracking_live
+
+
+def _logaddexp(a, b):
+    m = jnp.maximum(a, b)
+    return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_q,
+                         block_k, interpret):
+    """Forward: per-shard Pallas flash partials (normalized out_i + lse_i)
+    merged across ring steps by logsumexp weights.  Head-major in/out."""
+    from ..ops.flash_attention import _flash_fwd_pallas
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    q_off = idx * t_local
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    lse0 = jnp.full((b, h, t_local, 1), -1e30, jnp.float32)
+    out0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    if _vma_tracking_live(axis_name):
+        target_vma = tuple(jax.typeof(q).vma | {axis_name})
+        lse0, out0 = jax.tree_util.tree_map(
+            lambda x: lax.pcast(x, target_vma, to="varying"), (lse0, out0))
+
+    def step(carry, r):
+        (kc, vc), lse_run, out_run = carry
+        j = (idx - r) % n
+        out_i, lse_i = _flash_fwd_pallas(
+            q, kc, vc, None, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+            q_offset=q_off, k_offset=j * t_local, interpret=interpret)
+        new_lse = _logaddexp(lse_run, lse_i)
+        out_run = (out_run * jnp.exp(lse_run - new_lse)
+                   + out_i.astype(jnp.float32) * jnp.exp(lse_i - new_lse))
+        kc, vc = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis_name, perm), (kc, vc))
+        return ((kc, vc), new_lse, out_run), None
+
+    (_, lse, out), _ = lax.scan(step, ((k, v), lse0, out0), jnp.arange(n))
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
+                         block_q, block_k, interpret):
+    """Backward: re-rotate KV; per shard run the flash backward kernels
+    with the GLOBAL lse (so recomputed p are the true global softmax
+    probabilities); dq accumulates locally, dk/dv accumulate in buffers
+    that rotate WITH their kv shard and arrive home after the full cycle."""
+    from ..ops.flash_attention import _flash_bwd_pallas
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    q_off = idx * t_local
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    dk0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    dv0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    if _vma_tracking_live(axis_name):
+        target_vma = tuple(jax.typeof(q).vma | {axis_name})
+        dq0, dk0, dv0 = jax.tree_util.tree_map(
+            lambda x: lax.pcast(x, target_vma, to="varying"), (dq0, dk0, dv0))
+
+    # do/out are step-invariant: compute delta once, outside the scan.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def step(carry, r):
+        (kc, vc, dkc, dvc), dq = carry
+        j = (idx - r) % n
+        dq_i, dk_i, dv_i, _ = _flash_bwd_pallas(
+            q, kc, vc, None, out, lse, do, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+            q_offset=q_off, k_offset=j * t_local, delta=delta,
+            interpret=interpret)
+        dq = dq + dq_i.astype(jnp.float32)
+        dkc = dkc + dk_i.astype(jnp.float32)
+        dvc = dvc + dv_i.astype(jnp.float32)
+        kc, vc, dkc, dvc = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis_name, perm), (kc, vc, dkc, dvc))
+        return ((kc, vc, dkc, dvc), dq), None
+
+    ((_, _, dk, dv), dq), _ = lax.scan(
+        step, ((k, v, dk0, dv0), dq0), jnp.arange(n))
+    # n rotations = identity: dk/dv are home with every rank's contribution.
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+                interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                  block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, causal, sm_scale, block_q,
+                         block_k, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                    block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, sm_scale, block_q, block_k,
+                         interpret, res, do):
+    q, k, v, out, lse = res
+    return _ring_flash_bwd_impl(q, k, v, out, lse, do, axis_name, causal,
+                                sm_scale, block_q, block_k, interpret)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention(q, k, v, axis_name: str, *,
+                         causal: bool = False,
+                         sm_scale: Optional[float] = None,
+                         block_q: int = 512,
+                         block_k: int = 512,
+                         interpret: bool = False):
+    """Ring attention with the Pallas flash kernels as the local op.
+
+    Same contract as :func:`ring_attention` (call inside shard_map with
+    contiguous sequence shards [B, T/n, H, D] over ``axis_name``) but each
+    ring step runs the MXU flash kernel and saves only one fp32 logsumexp
+    per row; the backward re-rotates KV and runs the flash backward
+    kernels against the *global* lse, so gradients are exact.  Falls back
+    to the jnp :func:`ring_attention` off-TPU, when the shard length
+    doesn't block-align, or under ``shard_map``'s default vma tracking —
+    the kernel's dynamic global-offset scalars are rank-varying operands,
+    which the tracker rejects; run your ``shard_map`` with
+    ``check_vma=False`` to enable the kernel path.
+    """
+    from ..ops.flash_attention import _pick_block, _use_pallas, pltpu
+
+    t_local, d = q.shape[1], q.shape[3]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    bq = _pick_block(t_local, block_q)
+    bk = _pick_block(t_local, block_k)
+    use_kernel = ((interpret or _use_pallas()) and bq is not None
+                  and bk is not None and pltpu is not None
+                  and not _vma_tracking_live(axis_name))
+    if not use_kernel:
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              sm_scale=sm_scale)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _ring_flash(qt, kt, vt, axis_name, bool(causal), float(sm_scale),
+                      int(bq), int(bk), bool(interpret))
+    return out.transpose(0, 2, 1, 3)
+
+
 def ulysses_attention(q, k, v, axis_name: str, *,
                       causal: bool = False,
                       sm_scale: Optional[float] = None,

@@ -73,17 +73,13 @@ def _por_varying(flag, axis_name):
     over an extra axis is ``n * flag``, and the ``> 0`` turns either form
     into the OR.
     """
+    from .parallel.distributed import vma_tracking_live
+
     names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     # Trust an empty vma only when vma tracking is actually live on this
-    # trace (same probe as reduce_gradients, distributed.py:143-147):
-    # under shard_map(check_vma=False) every aval reports an empty vma,
-    # which must NOT be read as "already replicated".
-    try:
-        tracking = names and names[0] in jax.typeof(
-            jax.lax.axis_index(names[0])).vma
-    except Exception:
-        tracking = False
-    if tracking:
+    # trace: under shard_map(check_vma=False) every aval reports an empty
+    # vma, which must NOT be read as "already replicated".
+    if names and vma_tracking_live(names[0]):
         names = tuple(jax.typeof(flag).vma)
     if names:
         return jax.lax.psum(flag.astype(jnp.int32), names) > 0

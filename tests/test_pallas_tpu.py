@@ -230,3 +230,40 @@ def test_flash_attention_grads_on_chip(causal):
     for a, b in zip(g_k, g_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-3, rtol=2e-3)
+
+
+def test_flash_attention_dynamic_offsets_on_chip():
+    """The ring-attention hook: causal masking on GLOBAL positions via the
+    dynamic q_offset/k_offset SMEM scalars, compiled on chip."""
+    from apex_tpu.ops.attention import dot_product_attention
+    from apex_tpu.ops.flash_attention import _flash_fwd_pallas
+
+    rng = np.random.RandomState(10)
+    B, T, H, D = 1, 128, 2, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+
+    with jax.default_device(_tpu_dev()):
+        # q rows at global positions 128..255, k at 0..127 -> fully visible
+        out_past, _ = jax.jit(lambda q, k, v: _flash_fwd_pallas(
+            q, k, v, None, sm_scale=D ** -0.5, causal=True,
+            block_q=128, block_k=128, q_offset=128, k_offset=0))(q, k, v)
+        # diagonal shard: plain causal
+        out_diag, _ = jax.jit(lambda q, k, v: _flash_fwd_pallas(
+            q, k, v, None, sm_scale=D ** -0.5, causal=True,
+            block_q=128, block_k=128, q_offset=0, k_offset=0))(q, k, v)
+        # future shard: fully masked -> zeros, lse = NEG_INF
+        out_fut, lse_fut = jax.jit(lambda q, k, v: _flash_fwd_pallas(
+            q, k, v, None, sm_scale=D ** -0.5, causal=True,
+            block_q=128, block_k=128, q_offset=0, k_offset=128))(q, k, v)
+
+    qs, ks, vs = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    ref_past = dot_product_attention(qs, ks, vs).transpose(0, 2, 1, 3)
+    ref_diag = dot_product_attention(qs, ks, vs,
+                                     causal=True).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out_past), np.asarray(ref_past),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(out_diag), np.asarray(ref_diag),
+                               atol=2e-4, rtol=2e-4)
+    assert np.allclose(np.asarray(out_fut), 0.0)
+    assert np.all(np.asarray(lse_fut) <= -1e29)
