@@ -1,0 +1,135 @@
+"""Causal-LM pretraining with amp — the long-context training example.
+
+No reference counterpart (apex ships no LM example); this is the
+framework's long-context showcase: GPT with Pallas flash attention, the
+fused label-smoothing xentropy loss, FusedAdam with the BERT-style
+no-decay-on-bias/LayerNorm parameter groups, and the fully-jitted amp
+train step.  With ``--sp N`` the sequence is sharded over an ``sp`` mesh
+axis and attention runs as ring attention (``--attention ring`` or
+``ring_flash``).
+
+    python main_amp.py --synthetic --steps 5 --seq-len 256 --opt-level O2
+    python main_amp.py --synthetic --steps 2 --sp 2 --attention ring
+"""
+
+import os as _os
+import sys as _sys
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), *[_os.pardir] * 2)))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import training
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models import GPT
+from apex_tpu.training import make_train_step
+
+
+def parse():
+    p = argparse.ArgumentParser()
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("-b", "--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--vocab", type=int, default=8192)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--opt-level", type=str, default="O2")
+    p.add_argument("--loss-scale", type=str, default=None)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--weight-decay", type=float, default=0.1)
+    p.add_argument("--smoothing", type=float, default=0.0)
+    p.add_argument("--attention", type=str, default="flash",
+                   choices=["full", "blockwise", "flash", "ring",
+                            "ring_flash", "ulysses"])
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel ways (needs >= sp devices)")
+    return p.parse_args()
+
+
+def main():
+    args = parse()
+    loss_scale = args.loss_scale
+    if loss_scale not in (None, "dynamic") and loss_scale is not None:
+        loss_scale = float(loss_scale)
+
+    sp = args.sp
+    model = GPT(vocab_size=args.vocab, hidden_size=args.hidden,
+                num_layers=args.layers, num_heads=args.heads,
+                mlp_dim=4 * args.hidden, max_len=args.seq_len,
+                dtype=jnp.bfloat16, attention_impl=args.attention,
+                sp_axis="sp" if sp > 1 else None)
+    init_model = model if sp == 1 else GPT(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=args.heads,
+        mlp_dim=4 * args.hidden, max_len=args.seq_len,
+        dtype=jnp.bfloat16)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, args.vocab,
+                                  (args.batch_size, args.seq_len)))
+    # Next-token pairs are built GLOBALLY (before any sequence sharding,
+    # so labels never cross shard boundaries); T' = seq_len - 1 tokens.
+    x_tok, y_tok = ids[:, :-1], ids[:, 1:]
+    t_train = args.seq_len - 1
+    if sp > 1 and t_train % sp:
+        raise SystemExit(f"--seq-len must be 1 + multiple of --sp "
+                         f"(got {args.seq_len}, sp={sp})")
+    params = init_model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(params))
+    print(f"GPT {args.layers}L/{args.hidden}H  {n_params/1e6:.1f}M params  "
+          f"attention={args.attention}  opt_level = {args.opt_level}")
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        logits = model.apply({"params": p}, xb)
+        losses = softmax_cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]),
+            yb.reshape(-1), smoothing=args.smoothing)
+        return jnp.mean(losses)
+
+    init_fn, step_fn = make_train_step(
+        loss_fn, training.adam(args.lr, weight_decay=args.weight_decay),
+        opt_level=args.opt_level, loss_scale=loss_scale,
+        axis_name="sp" if sp > 1 else None)
+    state = init_fn(params)
+
+    if sp > 1:
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        devs = jax.devices()[:sp]
+        mesh = Mesh(np.array(devs), ("sp",))
+        # sequence sharded over sp; params/batch-rows replicated
+        step = jax.jit(shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(P(), (P(None, "sp"), P(None, "sp"))),
+            out_specs=(P(), P())))
+    else:
+        step = jax.jit(step_fn, donate_argnums=(0,))
+
+    tic = time.time()
+    for i in range(args.steps):
+        state, metrics = step(state, (x_tok, y_tok))
+        loss = float(jnp.ravel(metrics["loss"])[0])
+        toc = time.time()
+        tok_s = args.batch_size * (args.seq_len - 1) / max(toc - tic, 1e-9)
+        print(f"step {i}  loss {loss:.4f}  "
+              f"loss_scale {float(metrics['loss_scale']):.0f}  "
+              f"{tok_s:,.0f} tok/s")
+        tic = toc
+    assert np.isfinite(loss), "training diverged"
+
+
+if __name__ == "__main__":
+    main()

@@ -73,3 +73,21 @@ def test_prof_examples(monkeypatch, name, tmp_path):
     """The pyprof-examples analog (reference apex/pyprof/examples/)."""
     argv = [str(tmp_path / "trace")] if name == "end_to_end" else []
     _run_example(monkeypatch, f"examples/prof/{name}.py", argv)
+
+
+def test_lm_example(monkeypatch, capsys):
+    """GPT causal-LM example (flash attention path, fully-jitted step)."""
+    _run_example(monkeypatch, "examples/lm/main_amp.py", [
+        "--synthetic", "--steps", "2", "-b", "2", "--seq-len", "33",
+        "--hidden", "32", "--layers", "1", "--heads", "2",
+        "--vocab", "128", "--opt-level", "O2"])
+    out = capsys.readouterr().out
+    assert "opt_level = O2" in out
+
+
+def test_lm_example_sequence_parallel(monkeypatch):
+    """GPT over a 2-way sp mesh with ring attention."""
+    _run_example(monkeypatch, "examples/lm/main_amp.py", [
+        "--synthetic", "--steps", "2", "-b", "2", "--seq-len", "33",
+        "--hidden", "32", "--layers", "1", "--heads", "2",
+        "--vocab", "128", "--sp", "2", "--attention", "ring"])
