@@ -24,6 +24,7 @@ from .tensor_parallel import (column_parallel_dense,  # noqa: F401
                               tp_self_attention, shard_column, shard_row)
 from .pipeline import spmd_pipeline, stack_stage_params  # noqa: F401
 from .expert_parallel import moe_layer, MoEAux  # noqa: F401
+from .zero import zero1, zero1_partition_spec, Zero1State  # noqa: F401
 
 
 def convert_syncbn_model(module: nn.Module, axis_name: str = "data",

@@ -1,0 +1,148 @@
+"""ZeRO-1: optimizer-state sharding over the data axis.
+
+Beyond-parity scope (the reference is plain DP: every rank holds the full
+optimizer state).  The TPU-idiomatic ZeRO stage 1:
+
+* gradients are **reduce-scattered** (mean) over the axis — each rank
+  receives only its 1/n chunk of the flat gradient, replacing the DDP
+  all-reduce at *half* the collective cost;
+* the optimizer update runs on the local chunk only — moments and masters
+  for 1/n of the parameters live on each rank;
+* the updated chunk is **all-gathered** back into full replicated
+  parameters for the next forward.
+
+reduce_scatter + all_gather together move exactly what one all-reduce
+moves, so ZeRO-1 costs no extra communication while dividing optimizer
+memory by the axis size.
+
+The whole-model flat-buffer view reuses the multi-tensor capability
+(SURVEY §2.6: "whole-model single-launch updates"): the parameter pytree
+is raveled into ONE padded fp32 vector, chunked over the axis.  Works
+with elementwise optimizers (adam, sgd); per-tensor-norm optimizers
+(lamb, novograd) need tensor-granular sharding and are rejected — their
+trust ratios are wrong on arbitrary flat chunks.
+
+Usage (inside shard_map; the state's flat leaves are sharded over the
+axis with ``P(axis)``)::
+
+    tx = zero1(training.adam(1e-3), "data", num_shards=n)
+    init_fn, step_fn = make_train_step(
+        loss_fn, tx, opt_level="O2",
+        axis_name=("data",), reduce_grads=False)  # zero1 owns the
+        # reduction; axis_name still drives the mesh-wide dynamic-scaler
+        # overflow agreement (a locally-computed skip mask would desync
+        # scaler state and poison the moments of non-overflowing ranks
+        # whose reduce-scattered chunk contains another rank's inf).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Zero1State(NamedTuple):
+    inner: Any                    # wrapped optimizer's state over the chunk
+
+
+def _flatten(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    dtypes = {jnp.asarray(l).dtype for l in leaves}
+    if len(dtypes) != 1:
+        raise ValueError(
+            f"zero1 needs a uniform parameter dtype to build the flat "
+            f"buffer; got {sorted(map(str, dtypes))} — under amp O2 the "
+            f"fp32 masters satisfy this")
+    return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+
+def _unflatten(flat, like):
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    out, off = [], 0
+    for l in leaves:
+        size = l.size
+        out.append(flat[off:off + size].reshape(l.shape).astype(l.dtype))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def zero1(tx, axis_name: str, *, num_shards: int):
+    """Wrap a :class:`~apex_tpu.training.FunctionalOptimizer` with ZeRO-1
+    state sharding over ``axis_name`` (``num_shards`` = axis size, needed
+    at init time, which runs outside shard_map).
+
+    Returned optimizer contract: ``init(params)`` builds the FULL flat
+    state (shard its flat leaves over the axis via ``P(axis_name)`` in
+    your shard_map specs); ``update`` must run inside shard_map — it
+    reduce-scatters the gradients itself, so build the train step with
+    ``reduce_grads=False`` and keep ``axis_name`` set (the step still
+    needs it for the mesh-wide overflow agreement under dynamic scaling
+    and for the metric pmean).
+    """
+    from ..training import FunctionalOptimizer
+
+    name = getattr(getattr(tx, "update", None), "func", None)
+    fname = getattr(name, "__name__", "")
+    if "lamb" in fname or "novograd" in fname:
+        raise ValueError(
+            "zero1 supports elementwise optimizers (adam/sgd); "
+            f"{fname or 'this optimizer'} uses per-tensor norms that are "
+            "wrong on flat chunks — shard at tensor granularity instead")
+
+    def _padded_len(n_elems):
+        chunk = -(-n_elems // num_shards)
+        return chunk * num_shards
+
+    def init(params):
+        flat = _flatten(params)
+        pad = _padded_len(flat.size) - flat.size
+        flat = jnp.pad(flat, (0, pad))
+        return Zero1State(inner=tx.init(flat))
+
+    def update(grads, state, params, *, apply_mask=None, **kw):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        flat_p = _flatten(params)
+        flat_g = _flatten(grads).astype(flat_p.dtype)
+        pad = _padded_len(flat_p.size) - flat_p.size
+        if pad:
+            flat_p = jnp.pad(flat_p, (0, pad))
+            flat_g = jnp.pad(flat_g, (0, pad))
+        chunk = flat_p.size // n
+        # reduce-scatter(mean): the DDP gradient averaging, at half an
+        # all-reduce, delivering only this rank's chunk.
+        g_local = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                   tiled=True) / n
+        p_local = lax.dynamic_slice_in_dim(flat_p, idx * chunk, chunk)
+        new_p_local, new_inner = tx.update(
+            g_local, state.inner, p_local, apply_mask=apply_mask, **kw)
+        from .distributed import vma_tracking_live
+        if vma_tracking_live(axis_name):
+            # vma tracking cannot mark an all_gather result replicated, so
+            # gather as a masked psum (invariant output).  Costs one
+            # all-reduce instead of an all-gather; run your shard_map with
+            # check_vma=False to get the cheaper collective.
+            placed = lax.dynamic_update_slice_in_dim(
+                jnp.zeros_like(flat_p), new_p_local, idx * chunk, axis=0)
+            flat_new = lax.psum(placed, axis_name)
+        else:
+            flat_new = lax.all_gather(new_p_local, axis_name, tiled=True)
+        if pad:
+            flat_new = flat_new[:flat_p.size - pad]
+        return _unflatten(flat_new, params), Zero1State(inner=new_inner)
+
+    return FunctionalOptimizer(init=init, update=update)
+
+
+def zero1_partition_spec(state: Zero1State, axis_name: str):
+    """PartitionSpec pytree for a :class:`Zero1State`: flat (chunked)
+    leaves sharded over the axis, scalars replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(leaf):
+        return P(axis_name) if jnp.ndim(leaf) >= 1 else P()
+
+    return Zero1State(inner=jax.tree_util.tree_map(spec, state.inner))

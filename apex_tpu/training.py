@@ -129,6 +129,7 @@ def make_train_step(loss_fn: Callable,
                     keep_batchnorm_fp32: Optional[bool] = None,
                     cast_model_type=None,
                     axis_name: Optional[str] = None,
+                    reduce_grads: bool = True,
                     gradient_average: bool = True,
                     gradient_predivide_factor: float = 1.0,
                     allreduce_always_fp32: bool = False,
@@ -143,6 +144,11 @@ def make_train_step(loss_fn: Callable,
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)`` when
     ``has_model_state`` else ``loss_fn(params, batch) -> loss``.  Inside the
     step, ``params`` arrive already cast to the compute dtype per opt level.
+
+    ``reduce_grads=False`` keeps ``axis_name`` driving the mesh-wide
+    overflow agreement and the metric pmean but skips the DDP gradient
+    all-reduce — for optimizers that own the reduction themselves
+    (``parallel.zero.zero1`` reduce-scatters inside ``update``).
     """
     props = opt_levels[opt_level]()
     if loss_scale is not None:
@@ -198,7 +204,7 @@ def make_train_step(loss_fn: Callable,
         grads, (loss, new_ms) = jax.grad(scaled_loss, has_aux=True)(
             state.params)
 
-        if axis_name is not None:
+        if axis_name is not None and reduce_grads:
             grads = reduce_gradients(
                 grads, axis_name,
                 gradient_average=gradient_average,

@@ -59,21 +59,28 @@ def parse():
 
 def main():
     args = parse()
+    if not args.synthetic:
+        raise SystemExit("only --synthetic data is implemented; pass "
+                         "--synthetic (a real-data loader would plug in "
+                         "here via apex_tpu.data)")
     loss_scale = args.loss_scale
-    if loss_scale not in (None, "dynamic") and loss_scale is not None:
+    if loss_scale not in (None, "dynamic"):
         loss_scale = float(loss_scale)
 
     sp = args.sp
+    if sp > 1 and args.attention not in ("ring", "ring_flash", "ulysses"):
+        raise SystemExit(
+            f"--sp {sp} shards the sequence; attention_impl="
+            f"{args.attention!r} is shard-local and would silently attend "
+            f"within shards only — use ring, ring_flash or ulysses")
     model = GPT(vocab_size=args.vocab, hidden_size=args.hidden,
                 num_layers=args.layers, num_heads=args.heads,
                 mlp_dim=4 * args.hidden, max_len=args.seq_len,
                 dtype=jnp.bfloat16, attention_impl=args.attention,
                 sp_axis="sp" if sp > 1 else None)
-    init_model = model if sp == 1 else GPT(
-        vocab_size=args.vocab, hidden_size=args.hidden,
-        num_layers=args.layers, num_heads=args.heads,
-        mlp_dim=4 * args.hidden, max_len=args.seq_len,
-        dtype=jnp.bfloat16)
+    # Same architecture without the sp axis for (replicated) init.
+    init_model = model if sp == 1 else model.clone(attention_impl="full",
+                                                   sp_axis=None)
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(1, args.vocab,
@@ -114,7 +121,7 @@ def main():
         step = jax.jit(shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), (P(None, "sp"), P(None, "sp"))),
-            out_specs=(P(), P())))
+            out_specs=(P(), P())), donate_argnums=(0,))
     else:
         step = jax.jit(step_fn, donate_argnums=(0,))
 

@@ -1,0 +1,140 @@
+"""ZeRO-1 optimizer-state sharding: identical trajectories to plain DP,
+state memory divided by the axis size."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import training
+from apex_tpu.parallel.zero import zero1, zero1_partition_spec
+from apex_tpu.training import TrainState, make_train_step
+
+N = 4
+
+
+@pytest.fixture
+def dp_mesh():
+    return Mesh(np.array(jax.devices("cpu")[:N]), ("data",))
+
+
+def _setup():
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(5, 7) * 0.3, jnp.float32),
+              "b": jnp.zeros((3,), jnp.float32)}   # 38 elems: pad to 40
+    x = jnp.asarray(rng.randn(8 * N, 5), jnp.float32)
+    y = jnp.asarray(rng.randn(8 * N, 7) * 0.1, jnp.float32)
+    return params, x, y
+
+
+def _loss_fn(p, batch):
+    xb, yb = batch
+    pred = xb @ p["w"] + jnp.pad(p["b"], (0, 4))
+    return jnp.mean((pred - yb) ** 2)
+
+
+def _run(dp_mesh, tx, opt_spec, axis_name, steps=5, loss_scale=None,
+         reduce_grads=True, batch=None):
+    params, x, y = _setup()
+    if batch is not None:
+        x, y = batch
+    init_fn, step_fn = make_train_step(_loss_fn, tx, opt_level="O2",
+                                       loss_scale=loss_scale,
+                                       axis_name=axis_name,
+                                       reduce_grads=reduce_grads)
+    state = init_fn(params)
+    state_spec = TrainState(params=P(), opt_state=opt_spec,
+                            scaler=P(), model_state=P())
+
+    def wrapped(s, b):
+        ns, m = step_fn(s, b)
+        m = jax.tree_util.tree_map(
+            lambda v: training._pmean_varying(v, ("data",)), m)
+        return ns, m
+
+    step = jax.jit(shard_map(
+        wrapped, mesh=dp_mesh,
+        in_specs=(state_spec, (P("data"), P("data"))),
+        out_specs=(state_spec, P())))
+    losses = []
+    for _ in range(steps):
+        state, metrics = step(state, (x, y))
+        losses.append(float(jnp.ravel(metrics["loss"])[0]))
+    return np.asarray(losses), state
+
+
+def test_zero1_matches_plain_dp(dp_mesh):
+    plain_tx = training.adam(1e-2)
+    plain_losses, _ = _run(dp_mesh, plain_tx, P(), axis_name=("data",))
+
+    z_tx = zero1(training.adam(1e-2), "data", num_shards=N)
+    z_state0 = z_tx.init(_setup()[0])
+    z_spec = zero1_partition_spec(z_state0, "data")
+    zero_losses, _ = _run(dp_mesh, z_tx, z_spec, axis_name=("data",),
+                          reduce_grads=False)
+
+    np.testing.assert_allclose(zero_losses, plain_losses,
+                               rtol=1e-5, atol=1e-7)
+    assert zero_losses[-1] < zero_losses[0]
+
+
+def test_zero1_with_dynamic_scaling(dp_mesh):
+    z_tx = zero1(training.adam(1e-2), "data", num_shards=N)
+    z_spec = zero1_partition_spec(z_tx.init(_setup()[0]), "data")
+    losses, _ = _run(dp_mesh, z_tx, z_spec, axis_name=("data",),
+                     reduce_grads=False, loss_scale="dynamic")
+    assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_zero1_overflow_on_one_rank_skips_everywhere(dp_mesh):
+    """One rank's local inf grads must skip the step on EVERY rank: the
+    reduce-scattered chunks of non-overflowing ranks contain the inf
+    contribution, so a locally-decided mask would poison their moments
+    (the reason zero1 requires axis_name + reduce_grads=False)."""
+    params, x, y = _setup()
+    x = x.at[0, 0].set(np.inf)          # rank 0's shard only
+    z_tx = zero1(training.adam(1e-2), "data", num_shards=N)
+    z_spec = zero1_partition_spec(z_tx.init(params), "data")
+    _, state = _run(dp_mesh, z_tx, z_spec, axis_name=("data",),
+                    reduce_grads=False, loss_scale="dynamic", steps=1,
+                    batch=(x, y))
+    # params untouched (global skip), moments finite everywhere, scale halved
+    np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                  np.asarray(params["w"]))
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        assert np.all(np.isfinite(np.asarray(leaf, np.float32)))
+    assert float(state.scaler.loss_scale) == 2.**15
+
+
+def test_zero1_state_is_actually_sharded(dp_mesh):
+    """Each rank's flat moment chunks are 1/N of the padded total."""
+    params, _, _ = _setup()
+    z_tx = zero1(training.adam(1e-2), "data", num_shards=N)
+    state = z_tx.init(params)
+    flat_len = state.inner.exp_avg.size
+    assert flat_len % N == 0
+    assert flat_len >= 38                          # padded 38 -> 40
+
+    def probe(st):
+        return jnp.asarray(st.inner.exp_avg.shape[0])
+
+    spec = zero1_partition_spec(state, "data")
+    per_rank = jax.jit(shard_map(
+        probe, mesh=dp_mesh, in_specs=(spec,), out_specs=P(),
+        check_vma=False))(state)
+    assert int(per_rank) == flat_len // N
+
+
+def test_zero1_rejects_per_tensor_norm_optimizers():
+    with pytest.raises(ValueError, match="per-tensor norms"):
+        zero1(training.lamb(1e-3), "data", num_shards=4)
+
+
+def test_zero1_rejects_mixed_dtypes():
+    z_tx = zero1(training.adam(1e-2), "data", num_shards=4)
+    with pytest.raises(ValueError, match="uniform parameter dtype"):
+        z_tx.init({"a": jnp.zeros(3, jnp.float32),
+                   "b": jnp.zeros(3, jnp.bfloat16)})
