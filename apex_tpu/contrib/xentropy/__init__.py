@@ -27,6 +27,8 @@ import functools
 from typing import Tuple
 
 import jax
+
+from ...normalization.fused_layer_norm import _sds
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
@@ -121,8 +123,8 @@ def _fwd_pallas(logits, labels, smoothing):
                   pl.BlockSpec((blk, 1), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((blk, 1), lambda i: (i, 0)),
                    pl.BlockSpec((blk, 1), lambda i: (i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+        out_shape=[_sds((n, 1), jnp.float32, logits),
+                   _sds((n, 1), jnp.float32, logits)],
     )(logits, labels[:, None])
     return loss[:, 0], mlse[:, 0]
 
@@ -139,7 +141,7 @@ def _bwd_pallas(g, logits, mlse, labels, smoothing):
                   pl.BlockSpec((blk, 1), lambda i: (i, 0)),
                   pl.BlockSpec((blk, 1), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((blk, h), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, h), logits.dtype),
+        out_shape=_sds((n, h), logits.dtype, logits, g),
     )(g[:, None], logits, mlse[:, None], labels[:, None])
 
 

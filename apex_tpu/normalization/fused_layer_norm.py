@@ -106,6 +106,25 @@ def _bwd_input_ref(g2d, x2d, mean, invvar, weight):
     return dx.astype(x2d.dtype)
 
 
+
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct with the union of the operands' vma — required for
+    pallas_call outputs inside shard_map with check_vma=True."""
+    vma = None
+    for x in like:
+        try:
+            v = jax.typeof(x).vma
+        except AttributeError:
+            continue
+        vma = v if vma is None else (vma | v)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:       # older jax: no vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # -- pallas kernels -----------------------------------------------------------
 
 _ROW_BLOCK = 256
@@ -166,9 +185,9 @@ def _pallas_fwd(x2d, weight, bias, eps):
             pl.BlockSpec((rows, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n1, n2), x2d.dtype),
-            jax.ShapeDtypeStruct((n1, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n1, 1), jnp.float32),
+            _sds((n1, n2), x2d.dtype, x2d),
+            _sds((n1, 1), jnp.float32, x2d),
+            _sds((n1, 1), jnp.float32, x2d),
         ],
     )(x2d, w, b)
     return out, mean[:, 0], invvar[:, 0]
@@ -192,7 +211,7 @@ def _pallas_bwd_input(g2d, x2d, mean, invvar, weight):
             pl.BlockSpec((n2,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((rows, n2), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n1, n2), x2d.dtype),
+        out_shape=_sds((n1, n2), x2d.dtype, x2d, g2d),
     )(g2d, x2d, mean[:, None], invvar[:, None], w)
 
 

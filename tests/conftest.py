@@ -35,10 +35,16 @@ import pytest  # noqa: E402
 def pytest_collection_modifyitems(config, items):
     skip = pytest.mark.skip(
         reason="TPU kernel test: set APEX_TPU_TESTS=1 on a TPU host")
+    skip_cpu = pytest.mark.skip(
+        reason="CPU-mesh test: run without APEX_TPU_TESTS (on-chip mode "
+               "keeps the TPU default device, which breaks tests built "
+               "around the virtual CPU mesh)")
     run_on_chip = _ON_CHIP and jax.default_backend() == "tpu"
     for item in items:
         if "tpu" in item.keywords and not run_on_chip:
             item.add_marker(skip)
+        elif "tpu" not in item.keywords and run_on_chip:
+            item.add_marker(skip_cpu)
 
 
 @pytest.fixture

@@ -239,3 +239,36 @@ def test_distributed_cross_product_matches_single_process(opt_level,
         dist, single, rtol=2e-4 if opt_level == "O0" else 2e-3, atol=1e-6,
         err_msg=f"{opt_level}/{loss_scale}: DP trajectory diverged")
     assert dist[-1] < dist[0]
+
+
+def test_fp16_mode_tracks_oracle(oracle):
+    """cast_model_type=float16 (the reference's native half type) with
+    dynamic scaling: the full fp16 master-weight + overflow machinery,
+    selectable even though bf16 is the TPU default."""
+    dtype = jnp.float16
+    model = TinyModel(dtype=dtype)
+    x, y = _data()
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, ms, batch):
+        xb, yb = batch
+        logits, upd = model.apply({"params": p, "batch_stats": ms}, xb,
+                                  train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+        return loss, upd["batch_stats"]
+
+    tx = training.sgd(lr=0.05, momentum=0.9)
+    init_fn, step_fn = make_train_step(
+        loss_fn, tx, opt_level="O2", cast_model_type=jnp.float16,
+        loss_scale="dynamic", has_model_state=True)
+    state = init_fn(params, batch_stats)
+    step = jax.jit(step_fn)
+    losses = []
+    for _ in range(STEPS):
+        state, metrics = step(state, (x, y))
+        losses.append(float(metrics["loss"]))
+    traj = np.asarray(losses)
+    np.testing.assert_allclose(traj, oracle, atol=_TOL["O2"], rtol=0)
+    assert traj[-1] < traj[0]
