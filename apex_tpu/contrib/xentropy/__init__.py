@@ -28,7 +28,7 @@ from typing import Tuple
 
 import jax
 
-from ...normalization.fused_layer_norm import _sds
+from ...pallas_compat import sds_with_vma as _sds
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..pallas_compat import sds_with_vma as _sds
+
 try:  # TPU-only import; absent on CPU-only installs.
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -105,24 +107,6 @@ def _bwd_input_ref(g2d, x2d, mean, invvar, weight):
     dx = (gf - sum_g / n2 - xhat * sum_gx / n2) * invvar
     return dx.astype(x2d.dtype)
 
-
-
-def _sds(shape, dtype, *like):
-    """ShapeDtypeStruct with the union of the operands' vma — required for
-    pallas_call outputs inside shard_map with check_vma=True."""
-    vma = None
-    for x in like:
-        try:
-            v = jax.typeof(x).vma
-        except AttributeError:
-            continue
-        vma = v if vma is None else (vma | v)
-    if vma is None:
-        return jax.ShapeDtypeStruct(shape, dtype)
-    try:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    except TypeError:       # older jax: no vma kwarg
-        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 # -- pallas kernels -----------------------------------------------------------

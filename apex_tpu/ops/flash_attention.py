@@ -50,6 +50,7 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 from ..normalization.fused_layer_norm import _use_pallas
+from ..pallas_compat import sds_with_vma as _sds
 
 NEG_INF = -1e30
 _DEFAULT_BLOCK_Q = 512
@@ -157,25 +158,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, qoff_ref, koff_ref,
         out_ref[0, 0] = (acc_scr[:] / safe).astype(out_ref.dtype)
         lse_ref[0, 0] = jnp.where(l == 0.0, NEG_INF,
                                   m_scr[:] + jnp.log(safe))
-
-
-def _sds(shape, dtype, *like):
-    """ShapeDtypeStruct whose vma (varying-manual-axes) is the union of the
-    operands' — required for pallas_call outputs inside shard_map with
-    check_vma=True; harmless (empty vma) outside."""
-    vma = None
-    for x in like:
-        try:
-            v = jax.typeof(x).vma
-        except AttributeError:
-            continue
-        vma = v if vma is None else (vma | v)
-    if vma is None:
-        return jax.ShapeDtypeStruct(shape, dtype)
-    try:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    except TypeError:       # older jax: no vma kwarg
-        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _off_arg(offset):

@@ -1,0 +1,32 @@
+"""Shared Pallas/shard_map compatibility helpers.
+
+Lives at the package root (not under ``ops``/``normalization``) because
+both import it and ``ops`` ↔ ``normalization`` already depend on each
+other through the kernel gating.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["sds_with_vma"]
+
+
+def sds_with_vma(shape, dtype, *like):
+    """``ShapeDtypeStruct`` whose vma (varying-manual-axes) is the union
+    of the operands' — required for ``pallas_call`` outputs inside
+    ``shard_map`` with ``check_vma=True``; harmless (plain struct)
+    outside or on older jax without the ``vma`` kwarg."""
+    vma = None
+    for x in like:
+        try:
+            v = jax.typeof(x).vma
+        except AttributeError:
+            continue
+        vma = v if vma is None else (vma | v)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:       # older jax: no vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)

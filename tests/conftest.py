@@ -8,6 +8,14 @@ initializes its backends, hence the top-of-conftest placement.
 Note: the axon TPU plugin (if present) keeps "tpu" as the default backend
 even with JAX_PLATFORMS=cpu, so we pin the default *device* to cpu:0 and
 build test meshes from ``jax.devices("cpu")`` (see ``cpu_mesh``).
+
+Two modes:
+* default — the full suite on the virtual CPU mesh (the CI gate);
+* ``APEX_TPU_TESTS=1`` — a *kernel-validation* mode that leaves the
+  default device on the real TPU and runs ONLY the ``tpu``-marked tests;
+  everything else is skipped because the CPU-mesh pinning is global and
+  mixed-device runs produce spurious failures.  It complements, not
+  replaces, a default-mode run.
 """
 
 import os
