@@ -122,3 +122,48 @@ def test_bert_flash_impl_matches_full_off_tpu():
     out_flash = m_flash.apply(params, ids)
     np.testing.assert_allclose(np.asarray(out_full), np.asarray(out_flash),
                                atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("shape,blocks", [
+    ((1, 136, 1, 32), (136, 136)),    # sublane-only alignment (17*8), 1 head
+    ((3, 384, 5, 64), (128, 256)),    # mismatched bq/bk, odd head count
+    ((2, 256, 2, 128), (256, 128)),   # wide head_dim
+    ((1, 512, 3, 16), (512, 128)),    # narrow head_dim, whole-seq q block
+])
+def test_flash_interpret_fuzz_shapes(shape, blocks):
+    """Chunk-boundary style fuzzing (the reference's multi-tensor fuzz
+    strategy applied to the attention kernel): odd head counts, sublane-
+    only sequence alignment, asymmetric block sizes, extreme head dims —
+    fwd AND grads vs the oracle."""
+    B, T, H, D = shape
+    bq, bk = blocks
+    q, k, v = (_rand(shape, s + 10) for s in range(3))
+
+    out = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
+                          interpret=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.cos(fn(q, k, v)))
+
+    g1 = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_flash_interpret_inf_inputs_propagate():
+    """Non-finite Q rows must surface as non-finite outputs (the amp
+    overflow machinery depends on inf/nan propagating, reference
+    multi-tensor inf/NaN-injection strategy)."""
+    B, T, H, D = 1, 128, 2, 32
+    q, k, v = (_rand((B, T, H, D), s) for s in range(3))
+    q = q.at[0, 5, 0, :].set(np.inf)
+    out = flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
+    assert not np.all(np.isfinite(np.asarray(out)))

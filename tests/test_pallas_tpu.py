@@ -267,3 +267,21 @@ def test_flash_attention_dynamic_offsets_on_chip():
                                atol=2e-4, rtol=2e-4)
     assert np.allclose(np.asarray(out_fut), 0.0)
     assert np.all(np.asarray(lse_fut) <= -1e29)
+
+
+def test_flash_attention_sublane_only_shape_on_chip():
+    """T=136 (17x8, not a 128-multiple): whole-array blocks equal to the
+    array dims — the Mosaic edge _pick_block's sublane rule permits."""
+    from apex_tpu.ops.attention import dot_product_attention
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(11)
+    B, T, H, D = 1, 136, 1, 32
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+               for _ in range(3))
+    with jax.default_device(_tpu_dev()):
+        out = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
