@@ -102,16 +102,24 @@ class PrefetchLoader:
 
 def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
                        shuffle: bool = True, seed: int = 0,
-                       drop_last: bool = True):
+                       drop_last: bool = True, workers: int = 8):
     """Stream (uint8 NHWC batch, labels) from an ImageNet-style directory:
     ``root/<class_name>/*.{npy,jpg,jpeg,png}``.  ``.npy`` files must hold
-    HWC uint8; image files decode via PIL when available.  The heavy
-    epilogue (normalize) stays in :func:`normalize_images` (native C++).
+    HWC uint8; JPEG/PNG files decode via PIL (``workers`` decoder threads
+    per batch — PIL releases the GIL during decode).  The heavy epilogue
+    (normalize) stays in :func:`normalize_images` (native C++).
+
+    Honest scope note: the JPEG path is functional, not a DALI-class
+    decode engine (the reference leans on DALI for full-rate ImageNet,
+    ``examples/imagenet/main_amp.py:262-310``); the benchmarked input
+    paths are ``.npy`` and :func:`synthetic_imagenet`.
 
     ``drop_last=True`` (default) discards a trailing partial batch — the
     static-shape-friendly choice for jit'd train steps; pass
     ``drop_last=False`` to also yield the final short batch."""
+    import contextlib
     import os
+    from concurrent.futures import ThreadPoolExecutor
 
     classes = sorted(d for d in os.listdir(root)
                      if os.path.isdir(os.path.join(root, d)))
@@ -144,11 +152,17 @@ def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
         return img.astype(np.uint8)
 
     stop = (len(samples) - batch_size + 1) if drop_last else len(samples)
-    for i in range(0, stop, batch_size):
-        batch = samples[i:i + batch_size]
-        imgs = np.stack([load(p) for p, _ in batch])
-        labels = np.asarray([l for _, l in batch], np.int32)
-        yield imgs, labels
+    with contextlib.ExitStack() as stack:
+        if workers > 1:
+            pool = stack.enter_context(ThreadPoolExecutor(max_workers=workers))
+            mapper = pool.map
+        else:
+            mapper = map
+        for i in range(0, stop, batch_size):
+            batch = samples[i:i + batch_size]
+            imgs = np.stack(list(mapper(load, (p for p, _ in batch))))
+            labels = np.asarray([l for _, l in batch], np.int32)
+            yield imgs, labels
 
 
 def synthetic_imagenet(batch_size: int, image_size: int = 224,

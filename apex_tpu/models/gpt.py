@@ -66,6 +66,14 @@ class GPT(nn.Module):
                          (self.vocab_size, self.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (self.max_len, self.hidden_size), jnp.float32)
+        # Checked at trace time — JAX gather clamps out-of-range indices,
+        # so an oversized (global) sequence would silently reuse the last
+        # position embedding instead of erroring.
+        sp = 1 if self.sp_axis is None else jax.lax.axis_size(self.sp_axis)
+        if sp * t > self.max_len:
+            raise ValueError(
+                f"global sequence {sp} shard(s) x {t} tokens = {sp * t} "
+                f"exceeds max_len={self.max_len}")
         pos = jnp.arange(t)
         if self.sp_axis is not None:
             # Sequence-sharded: this shard's global positions.

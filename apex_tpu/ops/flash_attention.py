@@ -64,6 +64,7 @@ def _pick_block(t: int, preferred: int) -> Optional[int]:
     no legal block, caller falls back to the jnp path."""
     if t <= preferred:
         return t if t % 8 == 0 else None
+    preferred -= preferred % 128          # honor the multiple-of-128 claim
     for blk in range(preferred, 127, -128):
         if t % blk == 0:
             return blk
@@ -235,6 +236,11 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
         mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off)
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse_ref[0, 0])                           # lse: [bq, 1]
+    if causal:
+        # A fully-masked row has lse == NEG_INF, making exp(NEG_INF -
+        # NEG_INF) = 1 on masked entries; the forward kernel zeroes these,
+        # so the recompute must too.
+        p = jnp.where(mask, p, 0.0)
     dp = _mm(do_ref[0, 0], v_ref[0, 0], ((1,), (1,)))        # [bq, bk]
     ds = p * (dp - delta_ref[0, 0]) * sm_scale               # delta: [bq, 1]
     return p, ds

@@ -74,9 +74,14 @@ def moe_layer(x, router_w, expert_fn: Callable, expert_params, *,
     gate = jnp.max(probs, axis=-1)                               # [T]
     assign = jnp.argmax(probs, axis=-1)                          # [T]
 
-    # Switch load-balancing aux loss: E * sum_e f_e * P_e.
+    # Switch load-balancing aux loss: E * sum_e f_e * P_e, with f (expert
+    # token fractions) and P (router prob means) taken over the GLOBAL
+    # batch — mean-of-local-products != product-of-global-means when
+    # routing skews differ across ep ranks, so pmean both before the sum.
     f = jnp.mean(jax.nn.one_hot(assign, n_experts, dtype=jnp.float32), axis=0)
     p = jnp.mean(probs, axis=0)
+    f = lax.pmean(f, axis_name)
+    p = lax.pmean(p, axis_name)
     lb_loss = n_experts * jnp.sum(f * p)
 
     slot, kept = _dispatch_indices(assign, n_experts, capacity)

@@ -84,13 +84,15 @@ def zero1(tx, axis_name: str, *, num_shards: int):
     """
     from ..training import FunctionalOptimizer
 
-    name = getattr(getattr(tx, "update", None), "func", None)
-    fname = getattr(name, "__name__", "")
-    if "lamb" in fname or "novograd" in fname:
+    if not getattr(tx, "elementwise", False):
         raise ValueError(
-            "zero1 supports elementwise optimizers (adam/sgd); "
-            f"{fname or 'this optimizer'} uses per-tensor norms that are "
-            "wrong on flat chunks — shard at tensor granularity instead")
+            "zero1 requires an optimizer that declares elementwise=True "
+            "(FunctionalOptimizer capability flag) — adam/sgd qualify; "
+            "per-tensor-norm optimizers (lamb, novograd) compute wrong "
+            "trust ratios on arbitrary flat chunks, and unknown optimizers "
+            "are rejected by default.  Shard at tensor granularity instead, "
+            "or set elementwise=True on your FunctionalOptimizer if its "
+            "update truly treats every element independently")
 
     def _padded_len(n_elems):
         chunk = -(-n_elems // num_shards)

@@ -243,3 +243,110 @@ def profile_function(fn: Callable, *args, xla_cost: bool = True,
         except Exception:
             cost = None
     return Profile(records, cost)
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def _load_target(spec: str):
+    """Resolve ``module:attr`` to a Python object."""
+    import importlib
+
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--fn needs module:callable, got {spec!r}")
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _markers_table(path: str, top: int) -> str:
+    """Render a dumped-markers file (``prof.capture.dump_markers``) as the
+    reference's captured-op table (op name + arg shapes/dtypes)."""
+    import json as _json
+
+    lines = ["{:<28} {}".format("marker op", "args")]
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if i >= top:
+                lines.append("...")
+                break
+            m = _json.loads(line)
+            def fmt(d):
+                if "shape" in d:
+                    return f"{tuple(d['shape'])}:{d.get('dtype', '?')}"
+                if "value" in d:
+                    return repr(d["value"])
+                return d.get("type", "?")
+            args = [fmt(a) for a in m.get("args", [])]
+            args += [f"{k}={fmt(v)}" for k, v in m.get("kwargs", {}).items()]
+            lines.append("{:<28} {}".format(m.get("op", "?"), ", ".join(args)))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m apex_tpu.prof.analysis`` — the runnable analysis stage
+    (reference ``python -m apex.pyprof.prof net.dict``,
+    ``apex/pyprof/prof/prof.py:171``): per-op FLOPs/bytes report for a
+    target function, optionally joined with a measured trace dir and/or a
+    dumped-markers file.
+
+    The target is ``--fn module:callable``; by the graft-entry convention a
+    zero-argument target is called to obtain ``(fn, example_args)``
+    (``__graft_entry__:entry`` works out of the box), otherwise supply
+    ``--shape``/``--dtype`` per positional argument.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.prof.analysis",
+        description="Analytic per-op FLOPs/bytes report (+ optional "
+                    "measured-trace join).")
+    ap.add_argument("--fn", default="__graft_entry__:entry",
+                    help="module:callable — either returns (fn, args) when "
+                         "called with no arguments, or is profiled directly "
+                         "with --shape/--dtype example inputs")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="example-arg shape as comma-separated ints (repeat "
+                         "per positional argument); e.g. --shape 8,128")
+    ap.add_argument("--dtype", action="append", default=[],
+                    help="dtype per --shape (default float32)")
+    ap.add_argument("--trace", default=None,
+                    help="trace logdir to join measured op times "
+                         "(prof.parse stage output)")
+    ap.add_argument("--markers", default=None,
+                    help="dumped markers file (prof.capture.dump_markers)")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--no-xla-cost", action="store_true",
+                    help="skip the compile-based XLA cost cross-check")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    target = _load_target(args.fn)
+    if args.shape:
+        dtypes = list(args.dtype) + ["float32"] * (len(args.shape)
+                                                   - len(args.dtype))
+        ex = tuple(
+            jnp.zeros(tuple(int(s) for s in sh.split(",") if s), dt)
+            for sh, dt in zip(args.shape, dtypes))
+        fn = target
+    else:
+        fn, ex = target()
+        ex = tuple(jax.tree_util.tree_map(np.asarray, ex))
+
+    prof = profile_function(fn, *ex, xla_cost=not args.no_xla_cost)
+    print(prof.summary(top=args.top))
+    if args.trace:
+        from .parse import parse_trace, attach_measured
+        print()
+        print(attach_measured(prof, parse_trace(args.trace), top=args.top))
+    if args.markers:
+        print()
+        print(_markers_table(args.markers, args.top))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    raise SystemExit(main())

@@ -262,3 +262,41 @@ def attach_measured(profile, trace: TraceProfile, top: int = 20) -> str:
     if unmatched:
         lines.append("measured-only ops: " + ", ".join(unmatched[:10]))
     return "\n".join(lines)
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m apex_tpu.prof.parse <logdir>`` — parse a trace dir and
+    print the measured per-op report (the reference's runnable parse stage,
+    ``python -m apex.pyprof.parse net.sql`` → per-kernel dicts,
+    ``apex/pyprof/parse/parse.py:25``; here the "DB" is the XLA trace
+    directory written by :func:`apex_tpu.prof.capture.trace`)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.prof.parse",
+        description="Parse an XLA profiler trace directory into a measured "
+                    "per-op report.")
+    ap.add_argument("logdir", help="trace logdir (from prof.capture.trace)")
+    ap.add_argument("--module-filter", default=None,
+                    help="keep only ops whose hlo_module contains this "
+                         "substring (CPU/GPU-style traces)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows per table (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON record per measured op execution "
+                         "instead of the summary table (the net.dict analog)")
+    args = ap.parse_args(argv)
+
+    trace = parse_trace(args.logdir, module_filter=args.module_filter)
+    if args.json:
+        for r in trace.records:
+            print(json.dumps(r._asdict()))
+    else:
+        print(trace.summary(top=args.top))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    raise SystemExit(main())

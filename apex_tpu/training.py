@@ -89,17 +89,26 @@ def _por_varying(flag, axis_name):
 class FunctionalOptimizer(NamedTuple):
     init: Callable
     update: Callable      # (grads, state, params, lr, grad_scale, apply_mask)
+    # Declared capability, not inferred: True iff ``update`` treats every
+    # parameter element independently (no per-tensor norms / trust ratios),
+    # so it remains correct on arbitrary flat chunks of the parameter
+    # vector.  ``parallel.zero.zero1`` requires it; third-party optimizers
+    # must opt in explicitly — the conservative default keeps unknown
+    # optimizers out of chunk-sharded paths.
+    elementwise: bool = False
 
 
 def adam(lr=1e-3, **kw) -> FunctionalOptimizer:
     return FunctionalOptimizer(
-        F.adam_init, functools.partial(F.adam_update, lr=lr, **kw))
+        F.adam_init, functools.partial(F.adam_update, lr=lr, **kw),
+        elementwise=True)
 
 
 def sgd(lr=1e-3, momentum=0.0, **kw) -> FunctionalOptimizer:
     return FunctionalOptimizer(
         functools.partial(F.sgd_init, momentum=momentum),
-        functools.partial(F.sgd_update, lr=lr, momentum=momentum, **kw))
+        functools.partial(F.sgd_update, lr=lr, momentum=momentum, **kw),
+        elementwise=True)
 
 
 def lamb(lr=1e-3, **kw) -> FunctionalOptimizer:

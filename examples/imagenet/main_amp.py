@@ -4,10 +4,12 @@ entry point (``examples/imagenet/main_amp.py``): same CLI surface
 TPU-native mechanics (one jitted SPMD train step over a device mesh instead
 of hooks + NCCL; bf16 instead of fp16).
 
-Data: pass an ImageNet directory laid out as class subfolders of JPEG/npy
+Data: pass an ImageNet directory laid out as class subfolders of npy/JPEG
 files, or use --synthetic (default when no dir is given) for generated
-data — the pipeline (decode epilogue in native C++, threaded device
-prefetch) is identical either way.
+data.  The normalize epilogue (native C++) and threaded device prefetch
+are identical either way; JPEG decode itself is PIL on a thread pool —
+functional, but not a DALI-class engine (the reference uses DALI for
+full-rate ImageNet) — so .npy or --synthetic are the benchmarked paths.
 
 Run (single chip or full pod — same command, SPMD handles both):
     python main_amp.py --synthetic -b 128 --opt-level O2 [--sync_bn]

@@ -34,6 +34,12 @@ N, D_in, D_out = 64, 1024, 16
 
 def main():
     devices = jax.devices()
+    if _os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Honor an explicit CPU request even when an accelerator plugin
+        # keeps itself registered as the default backend (so the
+        # 8-virtual-device CPU-mesh recipe in the README works anywhere).
+        devices = jax.devices("cpu")
+        jax.config.update("jax_default_device", devices[0])
     world = len(devices)
     mesh = Mesh(np.array(devices), ("data",))
     print(f"world size {world} ({devices[0].platform})")

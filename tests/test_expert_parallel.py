@@ -55,7 +55,9 @@ def _run_moe(mesh, router, experts_stacked, x, capacity_factor):
         ep = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), ep)
         y, aux = moe_layer(x, router, _expert_fn, ep, axis_name="ep",
                            capacity_factor=capacity_factor)
-        # aux is per-rank; average to a replicated global diagnostic.
+        # load_balance_loss is already global (pmean'd inside moe_layer);
+        # dropped_fraction is per-rank — average it to a global diagnostic.
+        # pmean of the replicated loss is the identity, so one map is fine.
         aux = jax.tree_util.tree_map(
             lambda v: jax.lax.pmean(v, "ep"), aux)
         return y, aux
@@ -127,3 +129,32 @@ def test_moe_gradients_flow_to_experts_and_router(ep_mesh):
                     jax.tree_util.tree_leaves(r_stacked)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_moe_load_balance_loss_uses_global_means(ep_mesh):
+    """Switch aux loss must be E * sum_e f_e * P_e over the GLOBAL batch
+    (ADVICE r2): with routing skew across ranks, mean-of-local-products
+    differs from the correct product-of-global-means."""
+    router, experts = _params()
+    stacked = stack_stage_params(experts)
+    x = jnp.asarray(np.random.RandomState(7).randn(E * T, D) * 3, jnp.float32)
+
+    _, aux = _run_moe(ep_mesh, router, stacked, x, capacity_factor=E)
+
+    # Oracle on the full (unsharded) batch.
+    probs = jax.nn.softmax(x @ router, axis=-1)
+    f_g = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), E), axis=0)
+    p_g = jnp.mean(probs, axis=0)
+    want = float(E * jnp.sum(f_g * p_g))
+    got = float(aux.load_balance_loss)
+    assert abs(got - want) < 1e-5, (got, want)
+
+    # And the skew is real in this fixture: the per-rank means differ,
+    # so a mean-of-local-losses would NOT equal the global formula.
+    xs = x.reshape(E, T, D)
+    local = []
+    for r in range(E):
+        pr = jax.nn.softmax(xs[r] @ router, axis=-1)
+        fr = jnp.mean(jax.nn.one_hot(jnp.argmax(pr, -1), E), axis=0)
+        local.append(float(E * jnp.sum(fr * jnp.mean(pr, axis=0))))
+    assert abs(np.mean(local) - want) > 1e-4, (np.mean(local), want)

@@ -129,8 +129,27 @@ def test_zero1_state_is_actually_sharded(dp_mesh):
 
 
 def test_zero1_rejects_per_tensor_norm_optimizers():
-    with pytest.raises(ValueError, match="per-tensor norms"):
+    # lamb/novograd do NOT declare elementwise=True (per-tensor trust
+    # ratios are wrong on flat chunks).
+    with pytest.raises(ValueError, match="elementwise"):
         zero1(training.lamb(1e-3), "data", num_shards=4)
+    with pytest.raises(ValueError, match="elementwise"):
+        zero1(training.novograd(1e-3), "data", num_shards=4)
+
+
+def test_zero1_rejects_unknown_optimizers_by_default():
+    """Capability is declared, not name-sniffed (ADVICE r2): a third-party
+    optimizer without elementwise=True is rejected even if its name looks
+    innocent; opting in works."""
+    from apex_tpu.training import FunctionalOptimizer
+
+    sneaky = FunctionalOptimizer(init=lambda p: None,
+                                 update=lambda g, s, p, **kw: (p, s))
+    with pytest.raises(ValueError, match="elementwise"):
+        zero1(sneaky, "data", num_shards=4)
+
+    ok = sneaky._replace(elementwise=True)
+    zero1(ok, "data", num_shards=4)      # accepted
 
 
 def test_zero1_rejects_mixed_dtypes():
