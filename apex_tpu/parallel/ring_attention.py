@@ -180,7 +180,7 @@ def _ring_flash_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
     def step(carry, r):
         (kc, vc, dkc, dvc), dq = carry
         j = (idx - r) % n
-        dq_i, dk_i, dv_i, _ = _flash_bwd_pallas(
+        dq_i, dk_i, dv_i, _, _ = _flash_bwd_pallas(
             q, kc, vc, None, out, lse, do, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k,
             q_offset=q_off, k_offset=j * t_local, delta=delta,

@@ -134,10 +134,18 @@ def main():
                                      np.asarray(b[1], np.int32)))
 
     t0 = time.perf_counter()
+    t1 = n_done = 0
     for i, (imgs, labels) in enumerate(loader):
         if args.prof >= 0 and i >= args.prof:
             break
         state, metrics = step(state, (imgs, labels))
+        if i == 0:
+            # first step includes the jit compile; time steady state from
+            # here (the reference's AverageMeter skips warmup the same way,
+            # examples/imagenet/main_amp.py batch_time reset)
+            float(metrics["loss"])
+            t1 = time.perf_counter()
+        n_done = i + 1
         if i % args.print_freq == 0:
             loss = float(metrics["loss"])       # one host sync per print
             dt = time.perf_counter() - t0
@@ -145,6 +153,10 @@ def main():
             print(f"iter {i}  loss {loss:.4f}  speed {ips:.1f} img/s  "
                   f"loss_scale {float(metrics['loss_scale']):.0f}")
     jax.block_until_ready(state.params)
+    if n_done > 1:
+        steady = args.batch_size * (n_done - 1) / (time.perf_counter() - t1)
+        print(f"steady {steady:.1f} img/s over {n_done - 1} iters "
+              f"(excl iter 0 compile)")
     print("done")
 
 

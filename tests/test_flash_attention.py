@@ -167,3 +167,70 @@ def test_flash_interpret_inf_inputs_propagate():
     q = q.at[0, 5, 0, :].set(np.inf)
     out = flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
     assert not np.all(np.isfinite(np.asarray(out)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_interpret_2d_bias_fwd_and_grads(causal):
+    """[B, T, S] head-broadcast additive bias (segment masks, relative
+    position biases) on the kernel path — fwd + all four grads vs the
+    oracle, incl. the head-summed dbias from the dedicated kernel."""
+    B, T, H, D = 2, 256, 3, 32
+    q, k, v = (_rand((B, T, H, D), s) for s in range(3))
+    rng = np.random.RandomState(7)
+    seg = jnp.asarray(rng.randint(0, 2, (B, T)))
+    hard = jnp.where(seg[:, :, None] == seg[:, None, :], 0.0,
+                     -1e30).astype(jnp.float32)
+    soft = jnp.asarray(rng.randn(B, T, T), jnp.float32)
+
+    def f_flash(q, k, v, bias):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=causal, bias=bias, block_q=128, block_k=128,
+            interpret=True)))
+
+    def f_ref(q, k, v, bias):
+        return jnp.sum(jnp.sin(dot_product_attention(
+            q, k, v, causal=causal, bias=bias[:, None])))
+
+    for bias in (hard, soft):
+        np.testing.assert_allclose(
+            float(f_flash(q, k, v, bias)), float(f_ref(q, k, v, bias)),
+            atol=1e-4, rtol=1e-4)
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        if bias is soft:
+            assert float(jnp.linalg.norm(g2[3])) > 0
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
+
+
+def test_flash_2d_bias_combines_with_key_padding():
+    """bias= and key_padding_bias= together fold into one additive term."""
+    B, T, H, D = 1, 128, 2, 32
+    q, k, v = (_rand((B, T, H, D), s) for s in range(3))
+    rng = np.random.RandomState(3)
+    b2 = jnp.asarray(rng.randn(B, T, T), jnp.float32)
+    kb = jnp.asarray(rng.randn(B, T), jnp.float32)
+
+    out = flash_attention(q, k, v, bias=b2, key_padding_bias=kb,
+                          block_q=128, block_k=128, interpret=True)
+    ref = dot_product_attention(
+        q, k, v, bias=(b2 + kb[:, None, :])[:, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_per_head_bias_falls_back_to_jnp():
+    """[B, H, T, S] per-head bias: no kernel support, documented jnp
+    fallback computes the same function; 5-D shapes are rejected."""
+    B, T, H, D = 1, 128, 2, 32
+    q, k, v = (_rand((B, T, H, D), s) for s in range(3))
+    b4 = jnp.asarray(np.random.RandomState(1).randn(B, H, T, T) * .3,
+                     jnp.float32)
+    out = flash_attention(q, k, v, bias=b4, interpret=True)
+    ref = dot_product_attention(q, k, v, bias=b4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError, match="bias must be"):
+        flash_attention(q, k, v, bias=jnp.zeros((B, H, T, T, 1)),
+                        interpret=True)
