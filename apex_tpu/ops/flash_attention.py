@@ -583,7 +583,21 @@ def flash_attention(q, k, v, *, causal: bool = False,
         # [B, H, T, S] per-head bias: no kernel support — documented jnp
         # fallback below.
         per_head_bias, bias = bias, None
-    elif bias is not None and bias.ndim != 3:
+    elif bias is not None and bias.ndim == 3:
+        want = (q.shape[0], tq, tk)
+        if tuple(bias.shape) != want:
+            # [B,1,S]-style broadcastable biases must be materialized: the
+            # kernel BlockSpec indexes (b, qi, ki) into the full array and
+            # would silently read clamped garbage otherwise.  broadcast_to
+            # is transposed to a sum by autodiff, so dbias keeps the
+            # caller's shape.
+            try:
+                bias = jnp.broadcast_to(bias, want)
+            except ValueError:
+                raise ValueError(
+                    f"bias shape {bias.shape} is not broadcastable to "
+                    f"[batch, q_len, kv_len] = {want}") from None
+    elif bias is not None:
         raise ValueError(
             f"bias must be [batch, q_len, kv_len] (broadcast over heads) "
             f"or per-head [batch, heads, q_len, kv_len]; got {bias.shape}")

@@ -22,7 +22,9 @@ from .ring_attention import (ring_attention,  # noqa: F401
 from .tensor_parallel import (column_parallel_dense,  # noqa: F401
                               row_parallel_dense, tp_mlp,
                               tp_self_attention, shard_column, shard_row)
-from .pipeline import spmd_pipeline, stack_stage_params  # noqa: F401
+from .pipeline import (spmd_pipeline, spmd_pipeline_interleaved,  # noqa: F401
+                       stack_interleaved_stage_params,  # noqa: F401
+                       stack_stage_params)  # noqa: F401
 from .expert_parallel import moe_layer, MoEAux  # noqa: F401
 from .zero import zero1, zero1_partition_spec, Zero1State  # noqa: F401
 

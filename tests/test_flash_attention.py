@@ -234,3 +234,28 @@ def test_flash_per_head_bias_falls_back_to_jnp():
     with pytest.raises(ValueError, match="bias must be"):
         flash_attention(q, k, v, bias=jnp.zeros((B, H, T, T, 1)),
                         interpret=True)
+
+
+def test_flash_broadcastable_3d_bias():
+    """[B,1,S] broadcastable bias is materialized for the kernel path and
+    its gradient folds back to the caller's shape; incompatible shapes
+    raise loudly instead of reading clamped garbage."""
+    B, T, H, D = 1, 256, 2, 32
+    q, k, v = (_rand((B, T, H, D), s) for s in range(3))
+    nb = jnp.asarray(np.random.RandomState(2).randn(B, 1, T), jnp.float32)
+
+    out = flash_attention(q, k, v, bias=nb, block_q=128, block_k=128,
+                          interpret=True)
+    ref = dot_product_attention(q, k, v, bias=nb[:, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    g = jax.grad(lambda b: jnp.sum(flash_attention(
+        q, k, v, bias=b, block_q=128, block_k=128, interpret=True) ** 2))(nb)
+    gr = jax.grad(lambda b: jnp.sum(dot_product_attention(
+        q, k, v, bias=b[:, None]) ** 2))(nb)
+    assert g.shape == nb.shape
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               atol=5e-4, rtol=5e-4)
+
+    with pytest.raises(ValueError, match="not broadcastable"):
+        flash_attention(q, k, v, bias=jnp.zeros((B, 3, T)), interpret=True)
