@@ -38,17 +38,35 @@ class BertSelfAttention(nn.Module):
     attention_impl: str = "full"
     sp_axis: Optional[str] = None
     causal: bool = False
+    # Grouped-query attention (r3): kv projections produce only this many
+    # heads, shared across num_heads / num_kv_heads query heads.  The
+    # flash kernel shares KV via its index maps (no repeat); other impls
+    # repeat KV heads (correct, not bandwidth-saving).  None = MHA.
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
         d = x.shape[-1]
         head_dim = d // self.num_heads
-        dense = lambda name: nn.DenseGeneral(
-            (self.num_heads, head_dim), dtype=self.dtype,
+        n_kv = self.num_kv_heads or self.num_heads
+        if self.num_heads % n_kv:
+            raise ValueError(f"num_kv_heads {n_kv} must divide "
+                             f"num_heads {self.num_heads}")
+        dense = lambda name, heads: nn.DenseGeneral(
+            (heads, head_dim), dtype=self.dtype,
             param_dtype=jnp.float32, name=name)
-        q = dense("query")(x)
-        k = dense("key")(x)
-        v = dense("value")(x)
+        q = dense("query", self.num_heads)(x)
+        k = dense("key", n_kv)(x)
+        v = dense("value", n_kv)(x)
+        if n_kv != self.num_heads and self.attention_impl not in (
+                "flash", "blockwise", "full"):
+            raise ValueError(
+                f"num_kv_heads is supported by the flash/blockwise/full "
+                f"paths, not {self.attention_impl!r}")
+        if n_kv != self.num_heads and self.attention_impl in (
+                "blockwise", "full"):
+            k = jnp.repeat(k, self.num_heads // n_kv, axis=2)
+            v = jnp.repeat(v, self.num_heads // n_kv, axis=2)
         if self.attention_impl in ("ring", "ring_flash", "ulysses"):
             if mask is not None:
                 raise ValueError(
@@ -94,6 +112,7 @@ class BertLayer(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "full"
     sp_axis: Optional[str] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -101,6 +120,7 @@ class BertLayer(nn.Module):
         attn = BertSelfAttention(self.num_heads, self.dtype,
                                  attention_impl=self.attention_impl,
                                  sp_axis=self.sp_axis,
+                                 num_kv_heads=self.num_kv_heads,
                                  name="attention")(x, mask)
         x = FusedLayerNorm(normalized_shape=d, name="attention_ln")(
             x + attn).astype(x.dtype)
@@ -125,6 +145,7 @@ class BertEncoder(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "full"   # full | blockwise | flash | ring | ulysses
     sp_axis: Optional[str] = None      # mesh axis for ring/ulysses
+    num_kv_heads: Optional[int] = None  # GQA; flash/blockwise/full impls
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
@@ -152,6 +173,7 @@ class BertEncoder(nn.Module):
             x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
                           attention_impl=self.attention_impl,
                           sp_axis=self.sp_axis,
+                          num_kv_heads=self.num_kv_heads,
                           name=f"layer_{i}")(x, attention_mask)
         if self.num_classes is None:
             return x.astype(jnp.float32)

@@ -26,6 +26,7 @@ class GPTBlock(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "flash"
     sp_axis: Optional[str] = None
+    num_kv_heads: Optional[int] = None   # GQA: kv heads shared across q heads
 
     @nn.compact
     def __call__(self, x):
@@ -35,6 +36,7 @@ class GPTBlock(nn.Module):
         h = BertSelfAttention(self.num_heads, self.dtype,
                               attention_impl=self.attention_impl,
                               sp_axis=self.sp_axis, causal=True,
+                              num_kv_heads=self.num_kv_heads,
                               name="attention")(h)
         x = x + h
         h = FusedLayerNorm(normalized_shape=d, name="ln2")(x).astype(x.dtype)
@@ -58,6 +60,7 @@ class GPT(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "flash"   # full | blockwise | flash | ring | ulysses
     sp_axis: Optional[str] = None
+    num_kv_heads: Optional[int] = None   # GQA (llama-style); None = MHA
 
     @nn.compact
     def __call__(self, input_ids):
@@ -82,7 +85,9 @@ class GPT(nn.Module):
         for i in range(self.num_layers):
             x = GPTBlock(self.num_heads, self.mlp_dim, self.dtype,
                          attention_impl=self.attention_impl,
-                         sp_axis=self.sp_axis, name=f"block_{i}")(x)
+                         sp_axis=self.sp_axis,
+                         num_kv_heads=self.num_kv_heads,
+                         name=f"block_{i}")(x)
         x = FusedLayerNorm(normalized_shape=self.hidden_size,
                            name="ln_f")(x)
         return (x.astype(jnp.float32) @ wte.T).astype(jnp.float32)

@@ -175,9 +175,11 @@ def _off_arg(offset):
 
 
 def _off_spec():
+    # *_: the offset scalar is grid-invariant for every kernel regardless
+    # of grid rank (the dkv grid is 5-D under GQA, 4-D otherwise).
     if pltpu is None:  # pragma: no cover
-        return pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0))
-    return pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+        return pl.BlockSpec((1, 1), lambda *_: (0, 0))
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0),
                         memory_space=pltpu.SMEM)
 
 
@@ -194,13 +196,17 @@ def _bias2_operand(qk_bias, block_q, block_k):
 
 def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
                       q_offset=0, k_offset=0, qk_bias=None, interpret=False):
-    """q,k,v: [B, H, T, D] (head-major).  kbias: [B, S] or None.
+    """q: [B, H, T, D]; k,v: [B, H_kv, S, D] (head-major) with
+    ``H % H_kv == 0`` — grouped-query/multi-query attention shares each KV
+    head across ``H / H_kv`` query heads purely through the k/v BlockSpec
+    index maps (no repeat/materialization).  kbias: [B, S] or None.
     ``qk_bias``: [B, Tq, Tk] additive bias (broadcast over heads) or None.
     ``q_offset``/``k_offset``: global positions of the first query/key row
     (may be traced scalars — the ring-attention hook).
     Returns (out [B,H,T,D], lse [B,H,T,1] fp32)."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    grp = h // k.shape[1]                # query heads per KV head (GQA)
     nq, nk = tq // block_q, tk // block_k
     has_bias = kbias is not None
     has_bias2 = qk_bias is not None
@@ -222,8 +228,10 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, qi, ki: (b, h // grp, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, qi, ki: (b, h // grp, ki, 0)),
             pl.BlockSpec((1, 1, kb_block),
                          (lambda b, h, qi, ki: (b, 0, ki)) if has_bias
                          else (lambda b, h, qi, ki: (b, 0, 0))),
@@ -314,21 +322,32 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
                     b2_ref, qoff_ref, koff_ref,
                     *refs, sm_scale, causal, has_bias, has_bias2):
+    """Grid ``(b, h_kv, ki, hg, qi)``: group member ``hg`` (one of the
+    ``H/H_kv`` query heads sharing this KV head) sweeps OUTSIDE the qi
+    loop, so the (b, h_kv, ki) dk/dv output blocks are revisited only on
+    consecutive steps (resident scratch accumulation over qi AND hg),
+    while the per-q-head db block flushes each time its qi sweep ends.
+    grp == 1 (plain MHA) makes the hg dim a singleton — same kernel."""
     if has_bias:
         dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr = refs
     else:
         dk_ref, dv_ref, dk_scr, dv_scr = refs
         db_ref = db_scr = None
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    qi = pl.program_id(4)
+    nq = pl.num_programs(4)
+    hg = pl.program_id(3)
+    ng = pl.num_programs(3)
     ki = pl.program_id(2)
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
-    @pl.when(qi == 0)
+    @pl.when(jnp.logical_and(qi == 0, hg == 0))
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
-        if has_bias:
+
+    if has_bias:
+        @pl.when(qi == 0)
+        def _():
             db_scr[:] = jnp.zeros_like(db_scr)
 
     if causal:
@@ -356,11 +375,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
             # the caller divides back out.
             db_scr[:] = db_scr[:] + jnp.sum(ds, axis=0, keepdims=True)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(jnp.logical_and(qi == nq - 1, hg == ng - 1))
     def _():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
-        if has_bias:
+
+    if has_bias:
+        @pl.when(qi == nq - 1)
+        def _():
             db_ref[0, 0] = db_scr[:]
 
 
@@ -409,6 +431,8 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
                       block_q, block_k, q_offset=0, k_offset=0,
                       delta=None, qk_bias=None, interpret=False):
     b, h, tq, d = q.shape
+    h_kv = k.shape[1]
+    grp = h // h_kv                      # query heads per KV head (GQA)
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
     has_bias = kbias is not None
@@ -437,7 +461,7 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         def ix(f):
             return lambda *g: f(*gridargs_to_bqk(*g))
         qix = ix(lambda b, qi, ki, h: (b, h, qi, 0))
-        kix = ix(lambda b, qi, ki, h: (b, h, ki, 0))
+        kix = ix(lambda b, qi, ki, h: (b, h // grp, ki, 0))   # GQA share
         rix = qix
         bix = (ix(lambda b, qi, ki, h: (b, 0, ki)) if has_bias
                else ix(lambda b, qi, ki, h: (b, 0, 0)))
@@ -467,24 +491,28 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         interpret=interpret,
     )(q, k, v, do, lse, delta, kb, b2, qoff, koff)
 
-    in_specs, _, kix = specs(lambda b, h, ki, qi: (b, qi, ki, h))
+    # dkv grid (b, h_kv, ki, hg, qi): the hg dim walks the grp query heads
+    # sharing each KV head (singleton for plain MHA) — see kernel doc.
+    in_specs, _, kix = specs(
+        lambda b, hk, ki, hg, qi: (b, qi, ki, hk * grp + hg))
     out_specs = [pl.BlockSpec((1, 1, block_k, d), kix),
                  pl.BlockSpec((1, 1, block_k, d), kix)]
-    out_shape = [_sds((b, h, tk, d), k.dtype, q, k, v, do),
-                 _sds((b, h, tk, d), v.dtype, q, k, v, do)]
+    out_shape = [_sds((b, h_kv, tk, d), k.dtype, q, k, v, do),
+                 _sds((b, h_kv, tk, d), v.dtype, q, k, v, do)]
     scratch = [pltpu.VMEM((block_k, d), jnp.float32),
                pltpu.VMEM((block_k, d), jnp.float32)]
     if has_bias:
-        # Per-(batch, head) bias-gradient partials; summed over heads (and
-        # un-scaled) by the caller.
+        # Per-(batch, q-head) bias-gradient partials; summed over heads
+        # (and un-scaled) by the caller.
         out_specs.append(pl.BlockSpec(
-            (1, 1, 1, block_k), lambda b, h, ki, qi: (b, h, 0, ki)))
+            (1, 1, 1, block_k),
+            lambda b, hk, ki, hg, qi: (b, hk * grp + hg, 0, ki)))
         out_shape.append(_sds((b, h, 1, tk), jnp.float32, q, k, v, do))
         scratch.append(pltpu.VMEM((1, block_k), jnp.float32))
     outs = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           has_bias=has_bias, has_bias2=has_bias2),
-        grid=(b, h, nk, nq),
+        grid=(b, h_kv, nk, grp, nq),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
@@ -559,9 +587,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = _DEFAULT_BLOCK_Q,
                     block_k: int = _DEFAULT_BLOCK_K,
                     interpret: bool = False):
-    """Flash attention.  ``q,k,v``: [batch, seq, heads, head_dim] (the JAX
-    convention of ``apex_tpu.ops.attention``); returns the same shape.
+    """Flash attention.  ``q``: [batch, q_len, heads, head_dim]; ``k,v``:
+    [batch, kv_len, kv_heads, head_dim] (the JAX convention of
+    ``apex_tpu.ops.attention``); returns q's shape.
 
+    ``kv_heads`` may divide ``heads`` (grouped-query / multi-query
+    attention, r3): each KV head serves ``heads / kv_heads`` query heads
+    through the kernel's BlockSpec index maps — KV is never repeated or
+    materialized per query head, so GQA's KV-cache/bandwidth saving is
+    real on the kernel path.  The jnp fallback repeats KV heads instead
+    (correct, not bandwidth-saving).
     ``key_padding_bias``: optional additive bias [batch, kv_len] applied to
     every query row (use ``0`` for visible, large-negative for padded keys).
     ``bias``: optional additive bias [batch, q_len, kv_len] broadcast over
@@ -576,6 +611,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """
     tq, tk = q.shape[1], k.shape[1]
     d = q.shape[-1]
+    n_heads, n_kv = q.shape[2], k.shape[2]
+    if n_heads % n_kv or v.shape[2] != n_kv:
+        raise ValueError(
+            f"kv heads must divide query heads and match between k and v; "
+            f"got q heads {n_heads}, k heads {n_kv}, v heads {v.shape[2]}")
     if sm_scale is None:
         sm_scale = d ** -0.5
     per_head_bias = None
@@ -626,6 +666,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
             b4 = kb4 if b4 is None else b4 + kb4.astype(b4.dtype)
         if bias is not None:
             b4 = bias[:, None, :, :]
+        if n_kv != n_heads:      # GQA off the kernel path: repeat KV heads
+            k = jnp.repeat(k, n_heads // n_kv, axis=2)
+            v = jnp.repeat(v, n_heads // n_kv, axis=2)
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                    bias=b4)
 
