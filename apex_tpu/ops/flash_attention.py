@@ -22,7 +22,11 @@ Design:
   ``[bq, bk]`` orientation with leading-dim contractions where the output
   is K-major, so no operand ever needs a VMEM relayout/transpose.
   ``delta = rowsum(do * o)`` is a cheap jnp reduction fused by XLA.
-* causal masking skips fully-masked KV blocks via ``pl.when`` predication;
+* causal masking skips fully-masked KV blocks via ``pl.when`` predication,
+  and sliding-window local attention goes further with a BOUNDED grid:
+  only ``ceil(window/bk)+1`` KV blocks per Q block are even visited
+  (virtual-negative block ids clamp in the index maps and predicate off),
+  so local attention is O(T * window) in both compute and fetches;
   a key-side additive bias ``[batch, kv_len]`` covers padding masks and a
   head-broadcast ``[batch, q_len, kv_len]`` bias covers segment/2-D masks
   and relative-position biases, with its head-summed gradient produced by
@@ -76,13 +80,48 @@ def _pick_block(t: int, preferred: int) -> Optional[int]:
     return None
 
 
-def _causal_block_mask(qi, ki, bq, bk, q_off=0, k_off=0):
-    """Causal mask on GLOBAL positions: ``q_off``/``k_off`` are the global
-    offsets of this call's first query/key row (dynamic scalars under ring
-    attention, 0 for single-device use)."""
+def _causal_block_mask(qi, ki, bq, bk, q_off=0, k_off=0, window=None):
+    """Causal (optionally sliding-window) mask on GLOBAL positions:
+    ``q_off``/``k_off`` are the global offsets of this call's first
+    query/key row (dynamic scalars under ring attention, 0 for
+    single-device use).  ``window``: each query sees only the last
+    ``window`` keys (itself included) — mistral/longformer-style local
+    attention."""
     q_pos = q_off + qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_off + ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return q_pos >= k_pos
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask = jnp.logical_and(mask, q_pos - k_pos < window)
+    return mask
+
+
+def _block_live(qi, ki, bq, bk, q_off, k_off, window):
+    """Whether this (qi, ki) block intersects the causal/window band —
+    the block-skip predicate shared by all four kernels.  Blocks past the
+    diagonal AND blocks older than the window are skipped entirely, so
+    sliding-window attention costs O(T * window), not O(T^2)."""
+    run = q_off + qi * bq + bq - 1 >= k_off + ki * bk        # causal skip
+    if window is not None:
+        # newest key in block still inside the oldest query's window?
+        run = jnp.logical_and(
+            run, (q_off + qi * bq) - (k_off + ki * bk + bk - 1) < window)
+    return run
+
+
+def _window_span(window, bq, bk, q_offset, k_offset, nk):
+    """Static KV-block count per Q block for the BOUNDED sliding-window
+    grid, or None to keep the full masked grid.  Bounded requires equal
+    block sizes and static zero offsets (the ring path's dynamic offsets
+    shift the band per rank); a span covering the whole row buys nothing.
+    The bounded grid is what makes `window` O(T * window): a masked-only
+    implementation still FETCHES every skipped block."""
+    if window is None or bq != bk:
+        return None
+    if not (isinstance(q_offset, int) and isinstance(k_offset, int)
+            and q_offset == 0 and k_offset == 0):
+        return None
+    span = (window - 2) // bk + 2
+    return span if span < nk else None
 
 
 def _when(cond):
@@ -111,14 +150,18 @@ def _mm(a, b, dims):
 def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, b2_ref, qoff_ref, koff_ref,
                 out_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, causal, has_bias,
-                has_bias2):
-    ki = pl.program_id(3)
+                has_bias2, window, window_span=None):
+    j = pl.program_id(3)
     nk = pl.num_programs(3)
     qi = pl.program_id(2)
+    # Bounded sliding-window grid (window_span set): only span KV blocks
+    # per Q block are visited; j walks them ending at the diagonal (ki may
+    # be a virtual negative for early rows -> dead step).
+    ki = j if window_span is None else qi - (window_span - 1) + j
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
@@ -129,7 +172,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, b2_ref, qoff_ref, koff_ref,
     # block at all).
     if causal:
         q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
-        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+        run = _block_live(qi, ki, bq, bk, q_off, k_off, window)
+        if window_span is not None:
+            run = jnp.logical_and(run, ki >= 0)
     else:
         q_off = k_off = 0
         run = True
@@ -144,7 +189,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, b2_ref, qoff_ref, koff_ref,
         if has_bias2:
             s = s + b2_ref[0].astype(jnp.float32)        # [bq, bk] block
         if causal:
-            mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off)
+            mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off,
+                                      window)
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:]                                # [bq, 1]
         l_prev = l_scr[:]
@@ -160,7 +206,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kb_ref, b2_ref, qoff_ref, koff_ref,
         m_scr[:] = m_new
         l_scr[:] = l_new
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _():
         l = l_scr[:]
         safe = jnp.where(l == 0.0, 1.0, l)
@@ -195,7 +241,8 @@ def _bias2_operand(qk_bias, block_q, block_k):
 
 
 def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
-                      q_offset=0, k_offset=0, qk_bias=None, interpret=False):
+                      q_offset=0, k_offset=0, qk_bias=None, window=None,
+                      interpret=False):
     """q: [B, H, T, D]; k,v: [B, H_kv, S, D] (head-major) with
     ``H % H_kv == 0`` — grouped-query/multi-query attention shares each KV
     head across ``H / H_kv`` query heads purely through the k/v BlockSpec
@@ -213,11 +260,18 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
     kb = (kbias[:, None, :] if has_bias
           else jnp.zeros((b, 1, 128), jnp.float32))
     b2, b2_block, b2ix = _bias2_operand(qk_bias, block_q, block_k)
-    b2_spec = pl.BlockSpec(b2_block, lambda b, h, qi, ki: b2ix(b, qi, ki))
 
+    span = _window_span(window, block_q, block_k, q_offset, k_offset, nk)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               has_bias=has_bias, has_bias2=has_bias2)
+                               has_bias=has_bias, has_bias2=has_bias2,
+                               window=window, window_span=span)
     kb_block = block_k if has_bias else 128
+    if span is None:
+        _kc = lambda qi, j: j
+    else:          # clamped real block for a possibly-virtual ki
+        _kc = lambda qi, j: jnp.maximum(qi - (span - 1) + j, 0)
+    b2_spec = pl.BlockSpec(b2_block,
+                           lambda b, h, qi, j: b2ix(b, qi, _kc(qi, j)))
     # Align varying-manual-axes across ALL operands (rank-varying ring
     # offsets vs replicated biases vs sharded activations) so the kernel
     # traces under shard_map's default vma tracking.
@@ -225,23 +279,23 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
         q, k, v, kb, b2, _off_arg(q_offset), _off_arg(k_offset))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, h, nq, nk),
+        grid=(b, h, nq, span if span is not None else nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, j: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, qi, ki: (b, h // grp, ki, 0)),
+                         lambda b, h, qi, j: (b, h // grp, _kc(qi, j), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, qi, ki: (b, h // grp, ki, 0)),
+                         lambda b, h, qi, j: (b, h // grp, _kc(qi, j), 0)),
             pl.BlockSpec((1, 1, kb_block),
-                         (lambda b, h, qi, ki: (b, 0, ki)) if has_bias
-                         else (lambda b, h, qi, ki: (b, 0, 0))),
+                         (lambda b, h, qi, j: (b, 0, _kc(qi, j))) if has_bias
+                         else (lambda b, h, qi, j: (b, 0, 0))),
             b2_spec,
             _off_spec(),
             _off_spec(),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, j: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, j: (b, h, qi, 0)),
         ],
         out_shape=[
             _sds((b, h, tq, d), q.dtype, q, k, v),
@@ -261,7 +315,7 @@ def _flash_fwd_pallas(q, k, v, kbias, *, sm_scale, causal, block_q, block_k,
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
                     b2_ref, qi, ki, q_off, k_off, *, sm_scale, causal,
-                    has_bias, has_bias2):
+                    has_bias, has_bias2, window):
     """Shared bwd recompute: returns (p, ds), both [bq, bk] fp32."""
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
@@ -273,7 +327,7 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
     if has_bias2:
         s = s + b2_ref[0].astype(jnp.float32)
     if causal:
-        mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off)
+        mask = _causal_block_mask(qi, ki, bq, bk, q_off, k_off, window)
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse_ref[0, 0])                           # lse: [bq, 1]
     if causal:
@@ -288,19 +342,23 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
                    b2_ref, qoff_ref, koff_ref,
-                   dq_ref, dq_scr, *, sm_scale, causal, has_bias, has_bias2):
-    ki = pl.program_id(3)
+                   dq_ref, dq_scr, *, sm_scale, causal, has_bias, has_bias2,
+                   window, window_span=None):
+    j = pl.program_id(3)
     nk = pl.num_programs(3)
     qi = pl.program_id(2)
+    ki = j if window_span is None else qi - (window_span - 1) + j
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     if causal:
         q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
-        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+        run = _block_live(qi, ki, bq, bk, q_off, k_off, window)
+        if window_span is not None:
+            run = jnp.logical_and(run, ki >= 0)
     else:
         q_off = k_off = 0
         run = True
@@ -310,18 +368,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 delta_ref, kb_ref, b2_ref, qi, ki, q_off,
                                 k_off, sm_scale=sm_scale, causal=causal,
-                                has_bias=has_bias, has_bias2=has_bias2)
+                                has_bias=has_bias, has_bias2=has_bias2,
+                                window=window)
         dq_scr[:] = dq_scr[:] + _mm(ds.astype(k_ref.dtype), k_ref[0, 0],
                                     ((1,), (0,)))
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _():
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
                     b2_ref, qoff_ref, koff_ref,
-                    *refs, sm_scale, causal, has_bias, has_bias2):
+                    *refs, sm_scale, causal, has_bias, has_bias2, window,
+                    window_span=None, n_q_blocks=None):
     """Grid ``(b, h_kv, ki, hg, qi)``: group member ``hg`` (one of the
     ``H/H_kv`` query heads sharing this KV head) sweeps OUTSIDE the qi
     loop, so the (b, h_kv, ki) dk/dv output blocks are revisited only on
@@ -333,26 +393,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
     else:
         dk_ref, dv_ref, dk_scr, dv_scr = refs
         db_ref = db_scr = None
-    qi = pl.program_id(4)
+    j = pl.program_id(4)
     nq = pl.num_programs(4)
     hg = pl.program_id(3)
     ng = pl.num_programs(3)
     ki = pl.program_id(2)
+    qi = j if window_span is None else ki + j
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
-    @pl.when(jnp.logical_and(qi == 0, hg == 0))
+    @pl.when(jnp.logical_and(j == 0, hg == 0))
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     if has_bias:
-        @pl.when(qi == 0)
+        @pl.when(j == 0)
         def _():
             db_scr[:] = jnp.zeros_like(db_scr)
 
     if causal:
         q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
-        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+        run = _block_live(qi, ki, bq, bk, q_off, k_off, window)
+        if window_span is not None:
+            run = jnp.logical_and(run, qi <= n_q_blocks - 1)
     else:
         q_off = k_off = 0
         run = True
@@ -362,7 +425,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
         p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 delta_ref, kb_ref, b2_ref, qi, ki, q_off,
                                 k_off, sm_scale=sm_scale, causal=causal,
-                                has_bias=has_bias, has_bias2=has_bias2)
+                                has_bias=has_bias, has_bias2=has_bias2,
+                                window=window)
         do = do_ref[0, 0]
         # K-major outputs via leading-dim contraction — no transposes.
         dv_scr[:] = dv_scr[:] + _mm(p.astype(do.dtype), do,
@@ -375,20 +439,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
             # the caller divides back out.
             db_scr[:] = db_scr[:] + jnp.sum(ds, axis=0, keepdims=True)
 
-    @pl.when(jnp.logical_and(qi == nq - 1, hg == ng - 1))
+    @pl.when(jnp.logical_and(j == nq - 1, hg == ng - 1))
     def _():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
     if has_bias:
-        @pl.when(qi == nq - 1)
+        @pl.when(j == nq - 1)
         def _():
             db_ref[0, 0] = db_scr[:]
 
 
 def _bwd_db2_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
                     b2_ref, qoff_ref, koff_ref,
-                    db2_ref, db2_scr, *, sm_scale, causal, has_bias):
+                    db2_ref, db2_scr, *, sm_scale, causal, has_bias, window,
+                    window_span=None):
     """d(loss)/d(qk_bias) summed over heads.  Separate kernel with the
     HEAD axis innermost in the grid: the (b, qi, ki) output block is then
     revisited on consecutive grid steps only, so the VMEM scratch
@@ -398,7 +463,8 @@ def _bwd_db2_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
     hi = pl.program_id(3)
     nh = pl.num_programs(3)
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
+    ki = j if window_span is None else qi - (window_span - 1) + j
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(hi == 0)
@@ -407,7 +473,9 @@ def _bwd_db2_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 
     if causal:
         q_off, k_off = qoff_ref[0, 0], koff_ref[0, 0]
-        run = q_off + qi * bq + bq - 1 >= k_off + ki * bk
+        run = _block_live(qi, ki, bq, bk, q_off, k_off, window)
+        if window_span is not None:
+            run = jnp.logical_and(run, ki >= 0)
     else:
         q_off = k_off = 0
         run = True
@@ -417,7 +485,8 @@ def _bwd_db2_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 delta_ref, kb_ref, b2_ref, qi, ki, q_off,
                                 k_off, sm_scale=sm_scale, causal=causal,
-                                has_bias=has_bias, has_bias2=True)
+                                has_bias=has_bias, has_bias2=True,
+                                window=window)
         db2_scr[:] = db2_scr[:] + ds
 
     @pl.when(hi == nh - 1)
@@ -429,7 +498,8 @@ def _bwd_db2_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
 
 def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
                       block_q, block_k, q_offset=0, k_offset=0,
-                      delta=None, qk_bias=None, interpret=False):
+                      delta=None, qk_bias=None, window=None,
+                      interpret=False):
     b, h, tq, d = q.shape
     h_kv = k.shape[1]
     grp = h // h_kv                      # query heads per KV head (GQA)
@@ -449,6 +519,14 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         # inside the scan).
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)              # [B, H, Tq, 1]
+
+    span = _window_span(window, block_q, block_k, q_offset, k_offset, nk)
+    if span is None:
+        _kc = lambda qi, j: j                      # real == grid index
+        _qc = lambda ki, j: j
+    else:
+        _kc = lambda qi, j: jnp.maximum(qi - (span - 1) + j, 0)
+        _qc = lambda ki, j: jnp.minimum(ki + j, nq - 1)
 
     # vma-align all operands (see _flash_fwd_pallas).
     q, k, v, do, lse, delta, kb, b2, qoff, koff = _align_vma(
@@ -479,11 +557,12 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
             _off_spec(),
         ], qix, kix
 
-    in_specs, qix, _ = specs(lambda b, h, qi, ki: (b, qi, ki, h))
+    in_specs, qix, _ = specs(lambda b, h, qi, j: (b, qi, _kc(qi, j), h))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          has_bias=has_bias, has_bias2=has_bias2),
-        grid=(b, h, nq, nk),
+                          has_bias=has_bias, has_bias2=has_bias2,
+                          window=window, window_span=span),
+        grid=(b, h, nq, span if span is not None else nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d), qix),
         out_shape=_sds((b, h, tq, d), q.dtype, q, k, v, do),
@@ -494,7 +573,7 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
     # dkv grid (b, h_kv, ki, hg, qi): the hg dim walks the grp query heads
     # sharing each KV head (singleton for plain MHA) — see kernel doc.
     in_specs, _, kix = specs(
-        lambda b, hk, ki, hg, qi: (b, qi, ki, hk * grp + hg))
+        lambda b, hk, ki, hg, j: (b, _qc(ki, j), ki, hk * grp + hg))
     out_specs = [pl.BlockSpec((1, 1, block_k, d), kix),
                  pl.BlockSpec((1, 1, block_k, d), kix)]
     out_shape = [_sds((b, h_kv, tk, d), k.dtype, q, k, v, do),
@@ -506,13 +585,14 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
         # (and un-scaled) by the caller.
         out_specs.append(pl.BlockSpec(
             (1, 1, 1, block_k),
-            lambda b, hk, ki, hg, qi: (b, hk * grp + hg, 0, ki)))
+            lambda b, hk, ki, hg, j: (b, hk * grp + hg, 0, ki)))
         out_shape.append(_sds((b, h, 1, tk), jnp.float32, q, k, v, do))
         scratch.append(pltpu.VMEM((1, block_k), jnp.float32))
     outs = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          has_bias=has_bias, has_bias2=has_bias2),
-        grid=(b, h_kv, nk, grp, nq),
+                          has_bias=has_bias, has_bias2=has_bias2,
+                          window=window, window_span=span, n_q_blocks=nq),
+        grid=(b, h_kv, nk, grp, span if span is not None else nq),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
@@ -529,12 +609,16 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
 
     dbias2 = None
     if has_bias2:
+        # db2 ALWAYS uses the full masked grid: its output is the dense
+        # [B, Tq, Tk] bias gradient, and out-of-band blocks must be
+        # WRITTEN (as zeros) — a bounded grid would leave them undefined.
         in_specs, _, _ = specs(lambda b, qi, ki, h: (b, qi, ki, h))
         dbias2 = pl.pallas_call(
             functools.partial(_bwd_db2_kernel, sm_scale=sm_scale,
-                              causal=causal, has_bias=has_bias),
-            grid=(b, nq, nk, h),          # h INNERMOST — see kernel doc
-            in_specs=in_specs,
+                              causal=causal, has_bias=has_bias,
+                              window=window, window_span=None),
+            grid=(b, nq, nk, h),
+            in_specs=in_specs,            # h INNERMOST — see kernel doc
             out_specs=pl.BlockSpec((1, block_q, block_k),
                                    lambda b, qi, ki, h: (b, qi, ki)),
             out_shape=_sds((b, tq, tk), jnp.float32, q, k, v, do),
@@ -547,30 +631,31 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
 
 # -- custom VJP over the head-major layout -------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash(q, k, v, kbias, qkbias, sm_scale, causal, block_q, block_k,
-           interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, kbias, qkbias, sm_scale, causal, window, block_q,
+           block_k, interpret):
     out, _ = _flash_fwd_pallas(q, k, v, kbias, qk_bias=qkbias,
                                sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k,
-                               interpret=interpret)
+                               window=window, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, kbias, qkbias, sm_scale, causal, block_q,
-                    block_k, interpret):
+def _flash_fwd_rule(q, k, v, kbias, qkbias, sm_scale, causal, window,
+                    block_q, block_k, interpret):
     out, lse = _flash_fwd_pallas(q, k, v, kbias, qk_bias=qkbias,
                                  sm_scale=sm_scale, causal=causal,
-                                 block_q=block_q, block_k=block_k,
-                                 interpret=interpret)
+                                 window=window, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
     return out, (q, k, v, kbias, qkbias, out, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(sm_scale, causal, window, block_q, block_k, interpret,
+                    res, do):
     q, k, v, kbias, qkbias, out, lse = res
     dq, dk, dv, dbias, dbias2 = _flash_bwd_pallas(
         q, k, v, kbias, out, lse, do, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, qk_bias=qkbias,
+        window=window, block_q=block_q, block_k=block_k, qk_bias=qkbias,
         interpret=interpret)
     return dq, dk, dv, dbias, dbias2
 
@@ -584,6 +669,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     key_padding_bias=None,
                     bias=None,
+                    window: Optional[int] = None,
                     block_q: int = _DEFAULT_BLOCK_Q,
                     block_k: int = _DEFAULT_BLOCK_K,
                     interpret: bool = False):
@@ -605,6 +691,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     is computed by a dedicated kernel pass, so only pass a learnable bias
     when you need the grad.  A per-head [B, H, T, S] bias is accepted but
     ALWAYS takes the jnp path (no kernel support).
+    ``window``: sliding-window local attention (mistral/longformer style,
+    requires ``causal=True``) — each query sees the last ``window`` keys,
+    itself included; out-of-band KV blocks are skipped entirely, so the
+    kernel costs O(T * window) instead of O(T^2).
     On TPU (or with ``interpret=True``) runs the Pallas
     kernels; otherwise — or when the sequence doesn't tile — falls back to
     the jnp blockwise path, which computes the same function.
@@ -616,6 +706,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError(
             f"kv heads must divide query heads and match between k and v; "
             f"got q heads {n_heads}, k heads {n_kv}, v heads {v.shape[2]}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "local attention is causal)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if sm_scale is None:
         sm_scale = d ** -0.5
     per_head_bias = None
@@ -669,6 +765,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
         if n_kv != n_heads:      # GQA off the kernel path: repeat KV heads
             k = jnp.repeat(k, n_heads // n_kv, axis=2)
             v = jnp.repeat(v, n_heads // n_kv, axis=2)
+        if window is not None:   # sliding window as an additive band bias
+            wb = jnp.where(
+                (jnp.arange(tq)[:, None] - jnp.arange(tk)[None, :]) < window,
+                0.0, NEG_INF).astype(jnp.float32)
+            b4 = wb[None, None] if b4 is None else b4 + wb[None, None]
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                    bias=b4)
 
@@ -680,5 +781,6 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # bias keeps its own dtype ([B,T,S] is quadratic; an eager fp32 copy
     # would double its HBM footprint) — the kernels widen each block.
     out = _flash(qt, kt, vt, kb, bias, float(sm_scale), bool(causal),
+                 None if window is None else int(window),
                  int(bq), int(bk), bool(interpret))
     return out.transpose(0, 2, 1, 3)

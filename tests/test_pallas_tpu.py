@@ -465,3 +465,39 @@ def test_flash_gqa_kernels_on_chip():
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b, np.float32),
                                        atol=0.2, rtol=0.1)
+
+
+def test_flash_sliding_window_on_chip():
+    """Mosaic: bounded sliding-window grid (virtual-negative KV blocks
+    clamped in the index maps, dead steps predicated off) vs the band-bias
+    oracle — fwd + grads."""
+    from apex_tpu.ops.attention import dot_product_attention
+    from apex_tpu.ops.flash_attention import NEG_INF, flash_attention
+
+    rng = np.random.RandomState(0)
+    B, T, H, D, W = 1, 2048, 4, 64, 256
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D) * .5, jnp.bfloat16)
+               for _ in range(3))
+    band = jnp.where(
+        (jnp.arange(T)[:, None] - jnp.arange(T)[None, :]) < W, 0.0, NEG_INF)
+
+    f = lambda q, k, v: flash_attention(q, k, v, causal=True, window=W,
+                                        block_q=128, block_k=128)
+    ref = lambda q, k, v: dot_product_attention(q, k, v, causal=True,
+                                                bias=band[None, None])
+    with jax.default_device(_tpu_dev()):
+        out = jax.jit(f)(q, k, v)
+        g = jax.jit(jax.grad(
+            lambda *a: jnp.sum(f(*a).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+    r = ref(q, k, v)
+    gr = jax.jit(jax.grad(
+        lambda *a: jnp.sum(ref(*a).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(r, np.float32),
+                               atol=1e-2, rtol=1e-2)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=0.1, rtol=0.1)
