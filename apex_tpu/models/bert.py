@@ -43,6 +43,8 @@ class BertSelfAttention(nn.Module):
     # flash kernel shares KV via its index maps (no repeat); other impls
     # repeat KV heads (correct, not bandwidth-saving).  None = MHA.
     num_kv_heads: Optional[int] = None
+    # Sliding-window local attention (flash impl only, needs causal).
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -63,6 +65,12 @@ class BertSelfAttention(nn.Module):
             raise ValueError(
                 f"num_kv_heads is supported by the flash/blockwise/full "
                 f"paths, not {self.attention_impl!r}")
+        if self.window is not None and (self.attention_impl != "flash"
+                                        or not self.causal):
+            raise ValueError(
+                f"window (sliding-window local attention) needs "
+                f"attention_impl='flash' and causal=True; got "
+                f"impl={self.attention_impl!r}, causal={self.causal}")
         if n_kv != self.num_heads and self.attention_impl in (
                 "blockwise", "full"):
             k = jnp.repeat(k, self.num_heads // n_kv, axis=2)
@@ -85,6 +93,7 @@ class BertSelfAttention(nn.Module):
             if mask is not None:
                 kb = jnp.where(mask, 0.0, -1e9)
             ctx = flash_attention(q, k, v, causal=self.causal,
+                                  window=self.window,
                                   key_padding_bias=kb)
         elif self.attention_impl == "blockwise":
             from ..ops.attention import blockwise_attention

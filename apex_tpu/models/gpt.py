@@ -27,6 +27,7 @@ class GPTBlock(nn.Module):
     attention_impl: str = "flash"
     sp_axis: Optional[str] = None
     num_kv_heads: Optional[int] = None   # GQA: kv heads shared across q heads
+    window: Optional[int] = None         # sliding-window local attention
 
     @nn.compact
     def __call__(self, x):
@@ -37,6 +38,7 @@ class GPTBlock(nn.Module):
                               attention_impl=self.attention_impl,
                               sp_axis=self.sp_axis, causal=True,
                               num_kv_heads=self.num_kv_heads,
+                              window=self.window,
                               name="attention")(h)
         x = x + h
         h = FusedLayerNorm(normalized_shape=d, name="ln2")(x).astype(x.dtype)
@@ -61,6 +63,7 @@ class GPT(nn.Module):
     attention_impl: str = "flash"   # full | blockwise | flash | ring | ulysses
     sp_axis: Optional[str] = None
     num_kv_heads: Optional[int] = None   # GQA (llama-style); None = MHA
+    window: Optional[int] = None         # sliding-window local attention
 
     @nn.compact
     def __call__(self, input_ids):
@@ -87,6 +90,7 @@ class GPT(nn.Module):
                          attention_impl=self.attention_impl,
                          sp_axis=self.sp_axis,
                          num_kv_heads=self.num_kv_heads,
+                         window=self.window,
                          name=f"block_{i}")(x)
         x = FusedLayerNorm(normalized_shape=self.hidden_size,
                            name="ln_f")(x)

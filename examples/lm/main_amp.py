@@ -54,6 +54,13 @@ def parse():
                             "ring_flash", "ulysses"])
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel ways (needs >= sp devices)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA/MQA: kv heads shared across query heads "
+                        "(must divide --heads; flash kernel shares KV "
+                        "via index maps)")
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window local attention (causal, "
+                        "O(T*window) on the flash kernel)")
     return p.parse_args()
 
 
@@ -73,10 +80,17 @@ def main():
             f"--sp {sp} shards the sequence; attention_impl="
             f"{args.attention!r} is shard-local and would silently attend "
             f"within shards only — use ring, ring_flash or ulysses")
+    if args.window is not None and args.attention not in ("flash",):
+        raise SystemExit("--window needs --attention flash")
+    if args.kv_heads is not None and args.attention not in (
+            "flash", "blockwise", "full"):
+        raise SystemExit("--kv-heads needs --attention flash/blockwise/full "
+                         "(GQA is shard-local; ring/ulysses paths are MHA)")
     model = GPT(vocab_size=args.vocab, hidden_size=args.hidden,
                 num_layers=args.layers, num_heads=args.heads,
                 mlp_dim=4 * args.hidden, max_len=args.seq_len,
                 dtype=jnp.bfloat16, attention_impl=args.attention,
+                num_kv_heads=args.kv_heads, window=args.window,
                 sp_axis="sp" if sp > 1 else None)
     # Same architecture without the sp axis for (replicated) init.
     init_model = model if sp == 1 else model.clone(attention_impl="full",
