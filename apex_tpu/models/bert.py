@@ -45,6 +45,12 @@ class BertSelfAttention(nn.Module):
     num_kv_heads: Optional[int] = None
     # Sliding-window local attention (flash impl only, needs causal).
     window: Optional[int] = None
+    # Autoregressive decode mode (r3): one token per call, KV cached in a
+    # flax "cache" collection sized ``cache_len`` (GPT passes max_len).
+    # Decode is bandwidth-bound single-token work — plain jnp attention
+    # over the cache buffer, no kernel.  GQA caches only the kv heads.
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -65,17 +71,64 @@ class BertSelfAttention(nn.Module):
             raise ValueError(
                 f"num_kv_heads is supported by the flash/blockwise/full "
                 f"paths, not {self.attention_impl!r}")
-        if self.window is not None and (self.attention_impl != "flash"
-                                        or not self.causal):
+        if self.window is not None and not self.decode and (
+                self.attention_impl != "flash" or not self.causal):
             raise ValueError(
                 f"window (sliding-window local attention) needs "
                 f"attention_impl='flash' and causal=True; got "
                 f"impl={self.attention_impl!r}, causal={self.causal}")
-        if n_kv != self.num_heads and self.attention_impl in (
-                "blockwise", "full"):
+        if (n_kv != self.num_heads and not self.decode
+                and self.attention_impl in ("blockwise", "full")):
+            # decode caches the UN-repeated kv heads (the GQA memory win)
             k = jnp.repeat(k, self.num_heads // n_kv, axis=2)
             v = jnp.repeat(v, self.num_heads // n_kv, axis=2)
-        if self.attention_impl in ("ring", "ring_flash", "ulysses"):
+        if self.decode:
+            if not self.causal or mask is not None:
+                raise ValueError("decode mode is causal-only and takes no "
+                                 "padding mask — batch equal-length "
+                                 "prompts (padding is unsupported: cached "
+                                 "pad KV would be attended to)")
+            if x.shape[1] != 1:
+                raise ValueError(f"decode consumes ONE token per call, got "
+                                 f"sequence length {x.shape[1]}")
+            b_ = x.shape[0]
+            # has_variable BEFORE self.variable: False exactly on the init
+            # trace, where the cache must only be CREATED — persisting the
+            # dummy token's kv (and bumping the index) there would make
+            # every real sequence start with a ghost entry at position 0.
+            live_step = self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b_, self.cache_len, n_kv, head_dim), k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b_, self.cache_len, n_kv, head_dim), v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            i = ci.value
+            # NOTE the caller must bound steps by cache_len (generate()
+            # clamps): past it, dynamic_update_slice clamps the write and
+            # positions saturate — garbage, not an error (jit-safe guards
+            # would need checkify).
+            kf = jax.lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
+            vf = jax.lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+            if live_step:
+                ck.value, cv.value, ci.value = kf, vf, i + 1
+            # Grouped einsums keep the cache UN-repeated on the memory bus
+            # (decode is bandwidth-bound; repeating [B,L,n_kv,hd] to
+            # num_heads would multiply per-step HBM traffic by the group).
+            grp_ = self.num_heads // n_kv
+            qg = q.reshape(b_, 1, n_kv, grp_, head_dim)
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                           kf.astype(jnp.float32)) * (head_dim ** -0.5)
+            pos = jnp.arange(self.cache_len)
+            live = pos <= i
+            if self.window is not None:
+                live = jnp.logical_and(live, pos > i - self.window)
+            s = jnp.where(live[None, None, None, None, :], s, -1e30)
+            att = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhgqk,bkhd->bqhgd", att,
+                             vf.astype(jnp.float32))
+            ctx = ctx.reshape(b_, 1, self.num_heads, head_dim)
+        elif self.attention_impl in ("ring", "ring_flash", "ulysses"):
             if mask is not None:
                 raise ValueError(
                     "ring/ulysses attention paths take no padding mask; pad "

@@ -28,6 +28,8 @@ class GPTBlock(nn.Module):
     sp_axis: Optional[str] = None
     num_kv_heads: Optional[int] = None   # GQA: kv heads shared across q heads
     window: Optional[int] = None         # sliding-window local attention
+    decode: bool = False                 # KV-cache single-token decode
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -39,6 +41,8 @@ class GPTBlock(nn.Module):
                               sp_axis=self.sp_axis, causal=True,
                               num_kv_heads=self.num_kv_heads,
                               window=self.window,
+                              decode=self.decode,
+                              cache_len=self.cache_len,
                               name="attention")(h)
         x = x + h
         h = FusedLayerNorm(normalized_shape=d, name="ln2")(x).astype(x.dtype)
@@ -64,6 +68,7 @@ class GPT(nn.Module):
     sp_axis: Optional[str] = None
     num_kv_heads: Optional[int] = None   # GQA (llama-style); None = MHA
     window: Optional[int] = None         # sliding-window local attention
+    decode: bool = False                 # KV-cache autoregressive decode
 
     @nn.compact
     def __call__(self, input_ids):
@@ -76,14 +81,28 @@ class GPT(nn.Module):
         # so an oversized (global) sequence would silently reuse the last
         # position embedding instead of erroring.
         sp = 1 if self.sp_axis is None else jax.lax.axis_size(self.sp_axis)
-        if sp * t > self.max_len:
+        if not self.decode and sp * t > self.max_len:
             raise ValueError(
                 f"global sequence {sp} shard(s) x {t} tokens = {sp * t} "
                 f"exceeds max_len={self.max_len}")
-        pos = jnp.arange(t)
-        if self.sp_axis is not None:
-            # Sequence-sharded: this shard's global positions.
-            pos = pos + jax.lax.axis_index(self.sp_axis) * t
+        if self.decode:
+            # single-token step: position = tokens consumed so far.  The
+            # caller must bound total steps by max_len (generate() clamps;
+            # past it, positions/cache writes saturate silently).
+            if t != 1:
+                raise ValueError(f"decode consumes ONE token per call, "
+                                 f"got {t}")
+            live_step = self.has_variable("cache", "pos_index")
+            pi = self.variable("cache", "pos_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            pos = pi.value[None]
+            if live_step:           # init trace only creates the counter
+                pi.value = pi.value + 1
+        else:
+            pos = jnp.arange(t)
+            if self.sp_axis is not None:
+                # Sequence-sharded: this shard's global positions.
+                pos = pos + jax.lax.axis_index(self.sp_axis) * t
         x = (wte[input_ids] + wpe[pos][None]).astype(self.dtype)
         for i in range(self.num_layers):
             x = GPTBlock(self.num_heads, self.mlp_dim, self.dtype,
@@ -91,6 +110,8 @@ class GPT(nn.Module):
                          sp_axis=self.sp_axis,
                          num_kv_heads=self.num_kv_heads,
                          window=self.window,
+                         decode=self.decode,
+                         cache_len=self.max_len,
                          name=f"block_{i}")(x)
         x = FusedLayerNorm(normalized_shape=self.hidden_size,
                            name="ln_f")(x)
@@ -109,3 +130,61 @@ def gpt_tiny(**kw):
     kw.setdefault("mlp_dim", 256)
     kw.setdefault("max_len", 256)
     return GPT(**kw)
+
+
+def generate(model: GPT, params, prompt_ids, max_new_tokens: int, *,
+             temperature: float = 0.0, rng=None):
+    """Autoregressive generation with a KV cache (r3; the reference has no
+    model/inference code — SURVEY §5 long-context scope).
+
+    One compiled ``lax.scan`` drives both prefill and generation: each
+    step feeds one token (teacher-forced from the prompt while it lasts,
+    sampled afterwards) through the ``decode=True`` clone of ``model``,
+    whose per-layer caches live in a flax "cache" collection threaded as
+    scan carry.  Greedy when ``temperature == 0``, else softmax sampling.
+
+    Returns ``[B, P + max_new_tokens]`` token ids (prompt included),
+    truncated at ``model.max_len``.
+    """
+    import jax.random as jrandom
+
+    if model.sp_axis is not None:
+        raise ValueError("generate() decodes full sequences; build the "
+                         "model without sp_axis for inference")
+    dec = model.clone(decode=True)
+    b, p = prompt_ids.shape
+    total = min(p + max_new_tokens, model.max_len)
+    if rng is None:
+        rng = jrandom.PRNGKey(0)
+
+    # cache buffers are zeros by construction — build them from shapes
+    # only (a real dec.init would PRNG-initialize a full second parameter
+    # set just to throw it away)
+    shapes = jax.eval_shape(dec.init, jrandom.PRNGKey(0),
+                            jnp.zeros((b, 1), jnp.int32))["cache"]
+    cache0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    prompt = jnp.asarray(prompt_ids)
+
+    def step(carry, t):
+        cache, tok, key = carry
+        logits, upd = dec.apply({"params": params, "cache": cache},
+                                tok[:, None], mutable=["cache"])
+        logits = logits[:, 0]                       # [B, V]
+        key, sub = jrandom.split(key)
+        if temperature == 0.0:
+            sampled = jnp.argmax(logits, axis=-1)
+        else:
+            sampled = jrandom.categorical(sub, logits / temperature,
+                                          axis=-1)
+        # teacher-force while the prompt lasts: the NEXT input token
+        in_prompt = t + 1 < p
+        nxt = jnp.where(
+            in_prompt,
+            prompt[:, jnp.minimum(t + 1, p - 1)],
+            sampled)
+        return (upd["cache"], nxt, key), nxt
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache0, prompt[:, 0], rng), jnp.arange(total - 1))
+    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
