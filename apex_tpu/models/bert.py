@@ -49,6 +49,13 @@ class BertSelfAttention(nn.Module):
     # flax "cache" collection sized ``cache_len`` (GPT passes max_len).
     # Decode is bandwidth-bound single-token work — plain jnp attention
     # over the cache buffer, no kernel.  GQA caches only the kv heads.
+    #
+    # HARD BOUND (ADVICE r3): a decode ``apply()`` is only valid while
+    # ``cache_index < cache_len``.  Past it, ``dynamic_update_slice``
+    # clamps the cache write and positions saturate, silently producing
+    # garbage logits — there is no jit-safe error without checkify.
+    # ``GPT.generate()`` clamps its step count to respect this; callers
+    # driving ``apply()`` directly must bound their own loop.
     decode: bool = False
     cache_len: int = 0
 

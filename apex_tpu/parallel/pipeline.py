@@ -158,9 +158,25 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
     r = lax.axis_index(axis_name)
     leaves = jax.tree_util.tree_leaves(stage_params)
     v = int(leaves[0].shape[0])
+    # The [v, p, ...] round-robin stack must arrive with axis 1 already
+    # sharded to length 1 (P(None, "pp") inside shard_map).  Validate it
+    # here: squeezing on shape alone would let an unsharded stack (or
+    # pre-squeezed params) surface only as a confusing downstream shape
+    # error inside stage_fn (ADVICE r3).
+    bad = [tuple(q.shape) for q in leaves
+           if not (q.ndim >= 2 and q.shape[1] == 1)]
+    if bad:
+        why = ("axis 1 has length != 1 — the stack arrived unsharded or "
+               "pre-squeezed" if all(len(s) >= 2 for s in bad)
+               else "some leaves lack the [v, p] leading axes entirely")
+        raise ValueError(
+            f"stage_params must be this rank's [v, 1, ...] slice of the "
+            f"[v, p, ...] stack from stack_interleaved_stage_params, "
+            f"sharded over the pp axis with P(None, {axis_name!r}) inside "
+            f"shard_map; got leaves with shapes {bad[:3]} ({why}). "
+            f"Pass the UN-squeezed stack and shard axis 1.")
     params_v = jax.tree_util.tree_map(
-        lambda q: jnp.squeeze(q, axis=1) if q.ndim >= 2 and q.shape[1] == 1
-        else q, stage_params)
+        lambda q: jnp.squeeze(q, axis=1), stage_params)
 
     m = num_microbatches
     batch = x.shape[0]

@@ -138,8 +138,19 @@ def zero1(tx, axis_name: str, *, num_shards: int):
             # replicated type (and transposes to a cheap dynamic_slice),
             # so the default-config user pays one real all-gather — the
             # same collective as with check_vma=False.
-            flat_new = _all_gather_invariant(new_p_local, axis_name,
-                                             tiled=True)
+            #
+            # It is a PRIVATE jax API (jax._src.lax.parallel), so its
+            # signature may drift between releases; a TypeError here must
+            # degrade to the masked-psum fallback below, not explode at
+            # trace time (ADVICE r3).
+            try:
+                flat_new = _all_gather_invariant(new_p_local, axis_name,
+                                                 tiled=True)
+            except TypeError:
+                placed = lax.dynamic_update_slice_in_dim(
+                    jnp.zeros_like(flat_p), new_p_local, idx * chunk,
+                    axis=0)
+                flat_new = lax.psum(placed, axis_name)
         else:
             # Very old jax without the primitive: gather as a masked psum
             # (invariant output) — a full all-reduce of a zeros-placed
