@@ -826,6 +826,14 @@ def flash_attention(q, k, v, *, causal: bool = False,
         bias = bias + key_padding_bias[:, None, :].astype(bias.dtype)
         key_padding_bias = None
 
+    if bias is not None:
+        # The [B,T,S] bias path moves an extra (block_q, block_k) fp32
+        # block per grid step in BOTH directions (b2 input fwd/bwd, db2
+        # output + scratch) — at the 1024^2 default that is several more
+        # 4 MB VMEM residents the r4 block sweep (bias-free) never
+        # budgeted.  Cap the bias path at the r3-proven 512^2.
+        block_q = min(block_q, 512)
+        block_k = min(block_k, 512)
     bq = _pick_block(tq, block_q)
     bk = _pick_block(tk, block_k)
     vma_live = False       # under shard_map vma tracking, interpret-mode

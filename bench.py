@@ -70,7 +70,7 @@ def _chip_peak_flops():
 _CALIB_FN = {}     # (n, iters) -> jitted chain + operands, compiled once
 
 
-def _calibrate_peak(iters=6, reps=2, n=8192):
+def _calibrate_peak(iters=12, reps=2, n=8192):
     """Measure the chip's *achievable* wall-clock bf16 matmul rate.
 
     Design (round-3 fix of VERDICT r2 weak #1):
@@ -170,6 +170,31 @@ def _time_steps(step, state, batch, iters, warmup=3):
         state, m = step(state, batch)
     _force((m["loss"], state))      # full chain: metrics AND final state
     return (time.perf_counter() - t0) / iters, state
+
+
+def _time_steps_device_loop(step_fn, state, batch, k=8, calls=4, reps=3):
+    """Seconds/step with K steps chained into one program
+    (:func:`apex_tpu.training.chain_steps`): the TPU device-loop rate,
+    free of the tunnel's per-call dispatch overhead (~7 ms + ~22 us/arg
+    measured here — a 9-11 ms/step tax the jitted-per-step numbers pay).
+    The batch pool is the same batch broadcast K times; every step still
+    runs the full train-step math on its own carry."""
+    from apex_tpu.training import chain_steps
+
+    chained = jax.jit(chain_steps(step_fn), donate_argnums=(0,))
+    batches = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (k,) + a.shape), batch)
+    for _ in range(2):                     # compile + resharding warmup
+        state, m = chained(state, batches)
+    _force((m["loss"], state))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state, m = chained(state, batches)
+        _force((m["loss"], state))
+        best = min(best, (time.perf_counter() - t0) / (calls * k))
+    return best
 
 
 def _prof_top_ops(step, state, batch, steps=3, top=5):
@@ -325,7 +350,7 @@ def _make_resnet_step(opt_level, batch, image_size=224, num_classes=1000):
                                        has_model_state=True)
     state = init_fn(params, batch_stats)
     step = jax.jit(step_fn, donate_argnums=(0,))
-    return step, state, (x, y)
+    return step, state, (x, y), step_fn
 
 
 # -- BERT-base FusedAdam (BASELINE config 4; Pallas layernorm + xentropy) -----
@@ -387,7 +412,8 @@ def _make_bert_step(batch=16, seq=128):
     state = init_fn(params)
     step = jax.jit(step_fn, donate_argnums=(0,))
     hidden = int(emb_kernel.shape[1])
-    return step, state, (ids, labels), n_params, n_dense, hidden, vocab
+    return (step, state, (ids, labels), n_params, n_dense, hidden, vocab,
+            step_fn)
 
 
 # -- FusedAdam whole-model step vs eager per-tensor loop ----------------------
@@ -582,9 +608,15 @@ def _bench_examples(on_tpu):
     # (reference examples/imagenet/main_amp.py), O2 + dynamic scaling.
     # print-freq chosen so the LAST iteration prints (prof = k*freq + 1):
     # the reported speed line then covers every timed iteration.
+    # steps-per-call 8: the device-loop shape (training.chain_steps);
+    # print-freq 16: each print is a full pipeline-drain + round-trip on
+    # the tunnel (~0.5 s), so per-step printing measures the tunnel, not
+    # training (127 img/s in round 3 vs 2,570 print-free in round 4).
+    # prof 72 = 9 calls of 8; print cadence 16/8 = every 2nd call, so the
+    # LAST call (ci=8) prints and the speed line covers all 72 iters.
     args = (["--synthetic", "-a", "resnet50", "-b", "128", "--opt-level",
-             "O2", "--loss-scale", "dynamic", "--prof", "13",
-             "--print-freq", "4"] if on_tpu else
+             "O2", "--loss-scale", "dynamic", "--prof", "72",
+             "--print-freq", "16", "--steps-per-call", "8"] if on_tpu else
             ["--synthetic", "-a", "resnet18", "-b", "8", "--image-size",
              "64", "--opt-level", "O2", "--prof", "5", "--print-freq", "1"])
     stdout, wall = _run_example("examples/imagenet/main_amp.py", args)
@@ -682,34 +714,50 @@ def main():
     # JSON reports the spread (VERDICT r2 next #3).
     cal_before = _calibrate_peak() if on_tpu else []
 
-    step2, state2, data2 = _make_resnet_step("O2", batch, size)
+    step2, state2, data2, step_fn2 = _make_resnet_step("O2", batch, size)
+    # Copy the state BEFORE the donated jitted-per-step timing consumes
+    # it; the copy seeds the device-loop timing below.
+    state_dl = jax.tree_util.tree_map(jnp.copy, state2)
     t_o2, state2 = _time_steps(step2, state2, data2, iters)
     prof_resnet = _prof_top_ops(step2, state2, data2) if on_tpu else None
-    del step2, state2, data2
+    t_o2_dl = (_time_steps_device_loop(step_fn2, state_dl, data2)
+               if on_tpu else t_o2)
+    del step2, state2, data2, state_dl
     # O2 precision machinery measured in isolation on the same param tree
     # (cast + unscale/overflow + masked SGD update as ONE program): the
     # honest numerator for "plumbing share of step" — the full-step trace
     # can't attribute it because XLA fuses the update into wgrad convs.
     plumbing_ms = _measure_precision_plumbing() if on_tpu else None
-    step0, state0, data0 = _make_resnet_step("O0", batch, size)
+    step0, state0, data0, step_fn0 = _make_resnet_step("O0", batch, size)
+    state0_dl = jax.tree_util.tree_map(jnp.copy, state0)
     t_o0, _ = _time_steps(step0, state0, data0, iters)
-    del step0, state0, data0
+    t_o0_dl = (_time_steps_device_loop(step_fn0, state0_dl, data0)
+               if on_tpu else t_o0)
+    del step0, state0, data0, state0_dl
 
-    ips_o2, ips_o0 = batch / t_o2, batch / t_o0
+    # Headline img/s, MFU and the O2-vs-O0 ratio all use the device-loop
+    # rate (the deployment shape of a TPU training loop) for BOTH opt
+    # levels — same harness on both sides; the jitted-per-step wall
+    # numbers are reported beside them and carry the cross-round
+    # regression guard.
+    ips_o2, ips_o0 = batch / t_o2_dl, batch / t_o0_dl
     flops = _resnet_flops_per_step(batch, size)
-    implied_o2, implied_o0 = flops / t_o2, flops / t_o0
+    implied_o2, implied_o0 = flops / t_o2_dl, flops / t_o0_dl
 
     # BERT-base FusedAdam O2 — Pallas FusedLayerNorm + xentropy + flash
     # attention on chip.
     b_batch, b_seq = (16, 128) if on_tpu else (2, 32)
     (bstep, bstate, bdata, n_params, n_dense,
-     hidden, vocab) = _make_bert_step(b_batch, b_seq)
+     hidden, vocab, bstep_fn) = _make_bert_step(b_batch, b_seq)
+    bstate_dl = jax.tree_util.tree_map(jnp.copy, bstate)
     t_bert, bstate = _time_steps(bstep, bstate, bdata, max(iters // 2, 2))
     prof_bert = _prof_top_ops(bstep, bstate, bdata) if on_tpu else None
-    del bstep, bstate, bdata
+    t_bert_dl = (_time_steps_device_loop(bstep_fn, bstate_dl, bdata, k=16)
+                 if on_tpu else t_bert)
+    del bstep, bstate, bdata, bstate_dl
     bert_flops = _bert_flops_per_step(n_dense, b_batch, b_seq, hidden,
                                       vocab, 12)
-    bert_implied = bert_flops / t_bert
+    bert_implied = bert_flops / t_bert_dl
 
     # Long-context flash attention (beyond-parity): causal fwd+bwd at 8k.
     fa_seq = 8192 if on_tpu else 512
@@ -757,7 +805,11 @@ def main():
         "resnet50": {
             "batch": batch, "image_size": size, "iters": iters,
             "ms_per_step_o2": round(t_o2 * 1e3, 2),
+            # K=8 steps per program (apex_tpu.training.chain_steps): the
+            # deployment-shape rate the headline img/s and MFU use.
+            "ms_per_step_o2_device_loop": round(t_o2_dl * 1e3, 2),
             "ms_per_step_o0": round(t_o0 * 1e3, 2),
+            "ms_per_step_o0_device_loop": round(t_o0_dl * 1e3, 2),
             "images_per_sec_o0": round(ips_o0, 2),
             "mfu_o2_pct": round(100 * implied_o2 / peak, 1),
             "mfu_o0_pct": round(100 * implied_o0 / peak, 1),
@@ -780,6 +832,7 @@ def main():
             "batch": b_batch, "seq": b_seq, "n_params": n_params,
             "n_dense_params": n_dense,
             "ms_per_step": round(t_bert * 1e3, 2),
+            "ms_per_step_device_loop": round(t_bert_dl * 1e3, 2),
             "mfu_pct": round(100 * bert_implied / peak, 1),
             "mfu_vs_measured_pct": (
                 round(100 * bert_implied / measured_peak, 1)
@@ -856,15 +909,17 @@ def main():
         "metric": "resnet50_amp_o2_images_per_sec_per_chip",
         "value": round(ips_o2, 2),
         "unit": "images/sec",
-        "vs_baseline": round(t_o0 / t_o2, 3),
+        "vs_baseline": round(t_o0_dl / t_o2_dl, 3),
         "summary": {
             "resnet50_ms_o2_wall": round(t_o2 * 1e3, 2),
+            "resnet50_ms_o2_device_loop": round(t_o2_dl * 1e3, 2),
             "resnet50_ms_o2_device": prof_dev_ms,
             "resnet50_mfu_vs_measured_pct": (
                 round(100 * implied_o2 / measured_peak, 1)
                 if measured_peak else None),
             "plumbing_ms": plumbing_ms,
             "bert_ms": round(t_bert * 1e3, 2),
+            "bert_ms_device_loop": round(t_bert_dl * 1e3, 2),
             "bert_mfu_vs_measured_pct": (
                 round(100 * bert_implied / measured_peak, 1)
                 if measured_peak else None),

@@ -64,6 +64,13 @@ def parse():
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--image-size", default=224, type=int)
     p.add_argument("--steps-per-epoch", default=100, type=int)
+    p.add_argument("--steps-per-call", default=1, type=int,
+                   help="chain N train steps into ONE compiled program "
+                   "(apex_tpu.training.chain_steps) over the pre-staged "
+                   "synthetic pool — the TPU device-loop shape; host "
+                   "dispatch and metric fetches then cost once per N "
+                   "steps.  Synthetic data only (a real loader feeds "
+                   "per-step batches).")
     return p.parse_args()
 
 
@@ -117,10 +124,30 @@ def main():
         has_model_state=True)
     state = init_fn(variables["params"], variables["batch_stats"])
 
-    step = jax.jit(shard_map(
-        step_fn, mesh=mesh,
-        in_specs=(P(), (P("data"), P("data"))),
-        out_specs=(P(), P())), donate_argnums=(0,))
+    spc = max(1, args.steps_per_call)
+    if spc > 1 and not (args.synthetic or args.data is None):
+        raise SystemExit("--steps-per-call needs --synthetic (the device "
+                         "loop consumes a pre-staged batch stack)")
+    if spc > 1 and args.prof > 0 and args.prof % spc:
+        # The device loop advances spc steps per call; honor --prof at
+        # call granularity rather than silently overrunning it.
+        rounded = ((args.prof + spc - 1) // spc) * spc
+        print(f"note: --prof {args.prof} rounded up to {rounded} "
+              f"(multiple of --steps-per-call {spc})")
+        args.prof = rounded
+    if spc > 1:
+        # Device loop: scan spc steps per program.  The batch stack's
+        # leading (step) axis stays unsharded; the per-step batch axis
+        # shards over the mesh as before.
+        step = jax.jit(shard_map(
+            training.chain_steps(step_fn), mesh=mesh,
+            in_specs=(P(), (P(None, "data"), P(None, "data"))),
+            out_specs=(P(), P())), donate_argnums=(0,))
+    else:
+        step = jax.jit(shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(P(), (P("data"), P("data"))),
+            out_specs=(P(), P())), donate_argnums=(0,))
 
     if args.synthetic or args.data is None:
         # Synthetic data: pre-upload a fixed pool of batches ONCE and
@@ -140,7 +167,15 @@ def main():
                 jax.device_put(normalize_images(imgs), data_sh),
                 jax.device_put(np.asarray(labels, np.int32), data_sh)))
         total = args.steps_per_epoch * args.epochs
-        loader = (pool[i % pool_n] for i in range(total))
+        if spc > 1:
+            # Stack the pool into ONE [spc, batch, ...] lookahead the
+            # device loop scans per call (device-side stack, done once).
+            stack = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *(pool[i % pool_n] for i in range(spc)))
+            loader = (stack for _ in range(0, total, spc))
+        else:
+            loader = (pool[i % pool_n] for i in range(total))
     else:
         from apex_tpu.data import directory_imagenet
         stream = directory_imagenet(args.data, args.batch_size,
@@ -149,35 +184,48 @@ def main():
             stream, transform=lambda b: (normalize_images(b[0]),
                                          np.asarray(b[1], np.int32)))
 
+    def fetch_metrics(metrics):
+        """ONE device->host transfer per print window: stack the scalars
+        device-side first (each separate float() costs a full round-trip
+        through a tunneled chip).  Under the device loop metrics arrive
+        stacked [spc]; report the window's last step."""
+        packed = jnp.stack([jnp.ravel(metrics["loss"])[-1],
+                            jnp.ravel(metrics["loss_scale"])[-1]])
+        vals = np.asarray(packed)
+        return float(vals[0]), float(vals[1])
+
     t0 = time.perf_counter()
     t1 = n_done = 0
-    for i, (imgs, labels) in enumerate(loader):
+    warm = 2 * spc                    # first TWO calls compile (see below)
+    for ci, batch_or_stack in enumerate(loader):
+        i = ci * spc                  # global step index of this call
         if args.prof >= 0 and i >= args.prof:
             break
-        state, metrics = step(state, (imgs, labels))
-        if i <= 1:
-            # Steps 0 AND 1 both compile: step 0 the initial trace, step 1
+        state, metrics = step(state, batch_or_stack)
+        if ci <= 1:
+            # Calls 0 AND 1 both compile: call 0 the initial trace, call 1
             # a re-specialization because the donated state returns with
             # the mesh's NamedSharding (jit caches on input shardings).
             # Steady state starts after both (the reference's AverageMeter
             # skips warmup the same way).
-            float(metrics["loss"])
+            fetch_metrics(metrics)
             t1 = time.perf_counter()
-        n_done = i + 1
-        if i % args.print_freq == 0:
-            loss = float(metrics["loss"])       # one host sync per print
+        n_done = i + spc
+        if (i // spc) % max(1, args.print_freq // spc) == 0:
+            loss, scale = fetch_metrics(metrics)
             dt = time.perf_counter() - t0
-            ips = args.batch_size * (i + 1) / dt
-            print(f"iter {i}  loss {loss:.4f}  speed {ips:.1f} img/s  "
-                  f"loss_scale {float(metrics['loss_scale']):.0f}")
+            ips = args.batch_size * n_done / dt
+            print(f"iter {n_done - 1}  loss {loss:.4f}  "
+                  f"speed {ips:.1f} img/s  loss_scale {scale:.0f}")
     # force completion before stopping the clock (block_until_ready is a
     # no-op on the tunnel, so fetch one scalar of the final state)
     float(jnp.ravel(jax.tree_util.tree_leaves(state.params)[-1])[0]
           .astype(jnp.float32))
-    if n_done > 2:
-        steady = args.batch_size * (n_done - 2) / (time.perf_counter() - t1)
-        print(f"steady {steady:.1f} img/s over {n_done - 2} iters "
-              f"(excl 2 compile iters)")
+    if n_done > warm:
+        steady = (args.batch_size * (n_done - warm)
+                  / (time.perf_counter() - t1))
+        print(f"steady {steady:.1f} img/s over {n_done - warm} iters "
+              f"(excl {warm} compile iters)")
     print("done")
 
 

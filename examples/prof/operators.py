@@ -1,0 +1,79 @@
+"""prof example 8 — operator sweep inside a profiling window.
+
+The analog of reference ``apex/pyprof/examples/operators.py`` +
+``simple.py``: exercise the elementary tensor operators (unary/binary
+dunders, comparisons, matmul) and show the START/STOP window semantics —
+only work issued inside ``prof.trace`` is captured, the TPU mirror of
+``--profile-from-start off`` + ``profiler.start()/stop()``.
+
+    python examples/prof/operators.py [logdir]
+"""
+
+import sys
+import tempfile
+
+import os as _os
+import sys as _sys
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), *[_os.pardir] * 2)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import prof
+
+UNARY = ["__abs__", "__neg__"]
+BINARY = ["__add__", "__sub__", "__mul__", "__truediv__", "__pow__",
+          "__matmul__"]
+COMPARE = ["__lt__", "__le__", "__eq__", "__ne__", "__ge__", "__gt__"]
+INT_BINARY = ["__and__", "__or__", "__xor__", "__lshift__", "__rshift__",
+              "__mod__", "__floordiv__"]
+
+
+@prof.annotate("operator_sweep")
+def sweep(fa, fb, ia, ib):
+    outs = []
+    for op in UNARY:
+        outs.append(getattr(fa, op)())
+    for op in BINARY:
+        outs.append(getattr(fa, op)(fb))
+    for op in COMPARE:
+        outs.append(getattr(fa, op)(fb).astype(jnp.float32))
+    for op in INT_BINARY:
+        outs.append(getattr(ia, op)(ib).astype(jnp.float32))
+    return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="apex_tpu_prof_ops_")
+    prof.init()
+    rng = np.random.RandomState(0)
+    fa = jnp.asarray(rng.rand(256, 256) + 0.5, jnp.float32)
+    fb = jnp.asarray(rng.rand(256, 256) + 0.5, jnp.float32)
+    ia = jnp.asarray(rng.randint(1, 100, (256, 256)), jnp.int32)
+    ib = jnp.asarray(rng.randint(1, 8, (256, 256)), jnp.int32)
+
+    fn = jax.jit(sweep)
+    # OUTSIDE the window: compile + warm-up are not profiled.
+    float(fn(fa, fb, ia, ib))
+
+    with prof.trace(logdir):                  # profiler.start()
+        total = float(fn(fa, fb, ia, ib))
+    # profiler.stop() — work after this point is not captured.
+    float(fn(fa, fb, ia, ib))
+    print(f"operator sweep total {total:.3e}; trace in {logdir}")
+
+    p = prof.profile_function(sweep, fa, fb, ia, ib)
+    print(p.summary(top=12))
+    n_ops = len(UNARY) + len(BINARY) + len(COMPARE) + len(INT_BINARY)
+    print(f"swept {n_ops} operators")
+
+
+if __name__ == "__main__":
+    main()

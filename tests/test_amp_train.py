@@ -271,3 +271,41 @@ def test_accum_steps_rejects_indivisible_batch():
     state = init_fn({"w": jnp.ones((4, 2))})
     with pytest.raises(ValueError, match="not divisible"):
         jax.jit(step_fn)(state, jnp.ones((8, 4)))
+
+
+def test_chain_steps_matches_per_call_trajectory():
+    """K steps compiled into one program (training.chain_steps — the
+    device-loop shape the bench headline uses) must produce the same
+    trajectory as K jitted-per-step calls on the same batch sequence."""
+    from apex_tpu import training
+    from apex_tpu.training import chain_steps, make_train_step
+
+    rng = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(rng.randn(12, 24) / 4, jnp.float32),
+              "w2": jnp.asarray(rng.randn(24, 3) / 5, jnp.float32)}
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        z = jnp.tanh(xb @ p["w1"]) @ p["w2"]
+        return jnp.mean((z.astype(jnp.float32) - yb) ** 2)
+
+    init_fn, step_fn = make_train_step(
+        loss_fn, training.sgd(0.05, momentum=0.9), opt_level="O2",
+        loss_scale="dynamic")
+    xs = jnp.asarray(rng.randn(6, 8, 12), jnp.float32)
+    ys = jnp.asarray(rng.randn(6, 8, 3), jnp.float32)
+
+    state_a = init_fn(params)
+    step = jax.jit(step_fn)
+    per_call = []
+    for i in range(6):
+        state_a, m = step(state_a, (xs[i], ys[i]))
+        per_call.append(float(m["loss"]))
+
+    state_b = init_fn(params)
+    chained = jax.jit(chain_steps(step_fn))
+    state_b, ms = chained(state_b, (xs, ys))
+    np.testing.assert_allclose(np.asarray(ms["loss"]), per_call, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                    jax.tree_util.tree_leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -14,6 +14,10 @@ import sys
 import numpy as np
 import pytest
 
+# The example entry points are exercised on-chip by bench.py every round;
+# off the fast gate they cost ~5 min of CPU compiles.
+pytestmark = pytest.mark.slow
+
 import jax
 
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
@@ -68,10 +72,24 @@ def test_distributed_example(monkeypatch):
 
 
 @pytest.mark.parametrize("name", ["lenet", "user_annotation",
-                                  "custom_func_module", "end_to_end"])
+                                  "custom_func_module", "end_to_end",
+                                  "jit_function", "apex_ops"])
 def test_prof_examples(monkeypatch, name, tmp_path):
     """The pyprof-examples analog (reference apex/pyprof/examples/)."""
     argv = [str(tmp_path / "trace")] if name == "end_to_end" else []
+    _run_example(monkeypatch, f"examples/prof/{name}.py", argv)
+
+
+@pytest.mark.parametrize("name,argv", [
+    ("imagenet", ["-m", "resnet18", "-b", "4", "--image-size", "32"]),
+    ("operators", []),
+])
+def test_prof_examples_with_args(monkeypatch, name, argv, tmp_path):
+    """Round-4 recipes: imagenet-scale profiling CLI (reference
+    pyprof/examples/imagenet/) and the operator sweep + start/stop window
+    (operators.py + simple.py)."""
+    if name == "operators":
+        argv = [str(tmp_path / "trace")]
     _run_example(monkeypatch, f"examples/prof/{name}.py", argv)
 
 

@@ -110,6 +110,7 @@ def test_pick_block():
     assert _pick_block(1000, 512) is None    # no 128-multiple divides
 
 
+@pytest.mark.slow
 def test_bert_flash_impl_matches_full_off_tpu():
     """attention_impl='flash' (fallback path off-TPU) == 'full' oracle."""
     from apex_tpu.models import bert_tiny
@@ -126,7 +127,8 @@ def test_bert_flash_impl_matches_full_off_tpu():
 
 @pytest.mark.parametrize("shape,blocks", [
     ((1, 136, 1, 32), (136, 136)),    # sublane-only alignment (17*8), 1 head
-    ((3, 384, 5, 64), (128, 256)),    # mismatched bq/bk, odd head count
+    pytest.param((3, 384, 5, 64), (128, 256),   # mismatched bq/bk, odd
+                 marks=pytest.mark.slow),        # head count (slowest case)
     ((2, 256, 2, 128), (256, 128)),   # wide head_dim
     ((1, 512, 3, 16), (512, 128)),    # narrow head_dim, whole-seq q block
 ])
@@ -170,6 +172,7 @@ def test_flash_interpret_inf_inputs_propagate():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_flash_interpret_2d_bias_fwd_and_grads(causal):
     """[B, T, S] head-broadcast additive bias (segment masks, relative
     position biases) on the kernel path — fwd + all four grads vs the
@@ -302,6 +305,7 @@ def test_flash_gqa_rejects_nondivisible_heads():
         flash_attention(q, kv, kv, interpret=True)
 
 
+@pytest.mark.slow
 def test_gpt_gqa_forward_and_train():
     """GPT with num_kv_heads (llama-style GQA) trains end-to-end off-TPU
     (flash fallback repeats KV); kv projections carry fewer heads."""

@@ -43,6 +43,7 @@ def test_attention_impls_match_oracle(impl):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_lm_training_reduces_loss():
     """Next-token training with the fused xentropy loss at amp O2."""
     model = gpt_tiny(dtype=jnp.bfloat16, attention_impl="flash")
@@ -95,6 +96,7 @@ def test_gpt_ring_attention_matches_single_device(cpu_mesh):
 # -- KV-cache autoregressive decode -------------------------------------------
 
 @pytest.mark.parametrize("kw", [{}, {"num_kv_heads": 2}, {"window": 12}])
+@pytest.mark.slow
 def test_generate_matches_full_forward_greedy(kw):
     """generate()'s KV-cache decode must reproduce token-for-token the
     greedy sequence obtained by repeated FULL forward passes — incl. GQA
@@ -118,6 +120,7 @@ def test_generate_matches_full_forward_greedy(kw):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
 
 
+@pytest.mark.slow
 def test_generate_sampling_and_truncation():
     from apex_tpu.models import gpt_tiny
     from apex_tpu.models.gpt import generate

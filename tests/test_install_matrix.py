@@ -51,6 +51,7 @@ def test_u8_decode_python_tier_matches_native(monkeypatch):
     np.testing.assert_allclose(full, fallback, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pallas_disabled_tier_full_train_step(monkeypatch):
     """APEX_TPU_DISABLE_PALLAS=1: FusedLayerNorm + xentropy + flash all
     take the jnp tier and an O2 train step still runs and learns."""

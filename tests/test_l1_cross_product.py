@@ -109,7 +109,11 @@ def oracle():
     return run_config("O0", 1.0)
 
 
-@pytest.mark.parametrize("opt_level", _OPT_LEVELS)
+@pytest.mark.parametrize("opt_level", [
+    pytest.param("O0", marks=pytest.mark.slow),
+    pytest.param("O1", marks=pytest.mark.slow),
+    "O2",                                     # flagship on the fast gate
+    pytest.param("O3", marks=pytest.mark.slow)])
 def test_loss_scale_invariance(opt_level):
     """{1.0, 128.0, dynamic} trajectories are ulp-identical within one opt
     level (the install-parity analog of compare.py:36-64).  Power-of-two
@@ -225,8 +229,10 @@ def _run_sync(opt_level, loss_scale, axis_name, mesh=None, steps=STEPS):
 
 
 @pytest.mark.parametrize("opt_level,loss_scale",
-                         [("O0", 1.0), ("O2", 128.0), ("O2", "dynamic")])
-def test_distributed_cross_product_matches_single_process(opt_level,
+                         [pytest.param("O0", 1.0, marks=pytest.mark.slow),
+                          pytest.param("O2", 128.0, marks=pytest.mark.slow),
+                          ("O2", "dynamic")])   # flagship config on the
+def test_distributed_cross_product_matches_single_process(opt_level,  # fast gate
                                                           loss_scale,
                                                           cpu_mesh):
     """8-way DP (shard_map + SyncBN + DDP grad averaging) must reproduce

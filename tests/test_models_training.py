@@ -20,6 +20,7 @@ def _xent(logits, labels):
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
+@pytest.mark.slow
 def test_resnet_forward_shapes():
     model = ResNet18(num_classes=10)
     x = jnp.ones((2, 32, 32, 3))
@@ -29,7 +30,10 @@ def test_resnet_forward_shapes():
     assert out.dtype == jnp.float32
 
 
-@pytest.mark.parametrize("opt_level", ["O0", "O2", "O3"])
+@pytest.mark.parametrize("opt_level", [
+    pytest.param("O0", marks=pytest.mark.slow),   # O2 is the flagship
+    "O2",                                         # config; O0/O3 ride the
+    pytest.param("O3", marks=pytest.mark.slow)])  # full (slow) suite
 def test_resnet_train_step_loss_decreases(opt_level):
     model = ResNet18(num_classes=10, dtype=jnp.bfloat16
                      if opt_level in ("O2", "O3") else jnp.float32)
@@ -135,6 +139,7 @@ def test_dp_train_step_on_mesh():
                                atol=1e-6, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_bert_tiny_forward_and_train():
     model = bert_tiny(dtype=jnp.bfloat16)
     ids = jnp.asarray(np.random.RandomState(0).randint(0, 1024, (2, 16)))
@@ -157,6 +162,7 @@ def test_bert_tiny_forward_and_train():
     assert float(m["loss"]) < float(m0["loss"])
 
 
+@pytest.mark.slow
 def test_dcgan_shapes():
     g = Generator(ngf=8, nc=3)
     d = Discriminator(ndf=8)

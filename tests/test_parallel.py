@@ -218,6 +218,7 @@ def test_syncbn_matches_whole_batch_bn():
     np.testing.assert_allclose(out, expected, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_syncbn_running_stats_and_eval():
     mesh = _mesh()
     rng = np.random.RandomState(3)
@@ -269,6 +270,7 @@ def test_syncbn_groups():
     np.testing.assert_allclose(out[half:], exp1, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_syncbn_backward_matches_whole_batch():
     """Autodiff through psum == reference's hand-written backward
     (mean_dy/mean_dy_xmu allreduce)."""

@@ -126,6 +126,7 @@ def test_dump_markers(tmp_path):
 
 # -- measured-trace parse stage (VERDICT r2 #6) -------------------------------
 
+@pytest.mark.slow
 def test_parse_trace_roundtrip(tmp_path):
     """Capture a REAL device trace, parse it back, and join measured
     durations onto the static analysis (reference pyprof parse stage,
@@ -222,6 +223,7 @@ def _make_synthetic_trace(tmp_path):
         json.dump({"traceEvents": events}, f)
 
 
+@pytest.mark.slow
 def test_parse_cli_subprocess(tmp_path):
     """``python -m apex_tpu.prof.parse <logdir>`` is a runnable tool
     (reference ``python -m apex.pyprof.parse net.sql``, parse/parse.py:25)."""
@@ -245,6 +247,7 @@ def test_parse_cli_subprocess(tmp_path):
     assert rec["base_op"] == "fusion" and rec["duration_us"] == 100.0
 
 
+@pytest.mark.slow
 def test_analysis_cli_subprocess(tmp_path):
     """``python -m apex_tpu.prof.analysis --fn ... --shape ...`` emits the
     tabular flops/bytes report (reference ``python -m apex.pyprof.prof``,

@@ -37,6 +37,7 @@ def test_blockwise_matches_naive(causal):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_blockwise_grads_match_naive():
     q, k, v = _qkv(1)
 
@@ -125,6 +126,7 @@ def test_ulysses_rejects_indivisible_heads():
         jax.jit(f)(q, q, q)
 
 
+@pytest.mark.slow
 def test_bert_ring_matches_full_on_dp_sp_mesh():
     """BERT-tiny with ring attention + mean pooling on a 4x2 (data x sp)
     mesh must produce the same logits as the single-device model with the
