@@ -70,19 +70,35 @@ def scale_loss(loss,
         if hasattr(opt, "_post_amp_backward"):
             opt._post_amp_backward(loss_scaler)
 
-    # One host sync per step, like reference scaler.py:199-200.
     if not delay_overflow_check:
-        should_skip = loss_scaler.update_scale_sync()
-    else:
-        should_skip = False
+        # The scale state machine updates on device NOW; the host READ of
+        # the overflow flag is deferred to each optimizer's step(), which
+        # batches all pending scalers' flags into one transfer (the
+        # reference reads per scaler, scaler.py:199-200 — microseconds on
+        # GPU, a whole round-trip each on a tunneled chip).  Optimizers
+        # without the deferral hook fall back to an immediate read.
+        flag = loss_scaler.update_scale_deferred()
+        if flag is not None:
+            deferrable = all(hasattr(opt, "_note_pending_overflow")
+                             for opt in opt_list)
+            if deferrable:
+                for opt in opt_list:
+                    opt._note_pending_overflow(flag, loss_id)
+            else:
+                # Any optimizer without the deferral hook forces a read
+                # NOW — and once the flag is on the host there is nothing
+                # left to batch, so arm the hooked optimizers eagerly too
+                # rather than paying a second read at their step().
+                import jax
 
-    if should_skip:
-        for opt in opt_list:
-            if hasattr(opt, "_arm_skip_step"):
-                opt._arm_skip_step()
-        maybe_print("Gradient overflow.  Skipping step, loss scaler {} "
-                    "reducing loss scale to {}".format(
-                        loss_id, loss_scaler.loss_scale()))
+                if bool(jax.device_get(flag)):
+                    for opt in opt_list:
+                        if hasattr(opt, "_arm_skip_step"):
+                            opt._arm_skip_step()
+                    maybe_print(
+                        "Gradient overflow.  Skipping step, loss scaler "
+                        "{} reducing loss scale to {}".format(
+                            loss_id, loss_scaler.loss_scale()))
 
     # Weight-cast cache dropped once per iteration (reference handle.py:153-155).
     autocast.clear_cast_cache()

@@ -200,6 +200,27 @@ class LossScaler:
         self._state = self.update_scale(self._state)
         return should_skip
 
+    def update_scale_deferred(self):
+        """Imperative update with the host read DEFERRED: runs the same
+        device-side scale state machine as :meth:`update_scale_sync` but
+        returns the pre-update overflow flag as a DEVICE scalar (or None
+        for static scalers, which never skip) instead of reading it.
+
+        The caller batches the reads —
+        ``FusedOptimizer._resolve_pending_overflows`` (``optimizers/
+        base.py``, called from ``step``) stacks every pending scaler's
+        flag into ONE device->host transfer, so a multi-loss iteration
+        (e.g. DCGAN's three scalers) pays one round-trip per optimizer
+        step instead of one per scaler.  On GPU
+        the reference's per-scaler read costs microseconds; through a
+        tunneled chip each read is ~0.1-0.3 s, which made this the
+        dominant cost of the imperative path.  Skip/step decisions are
+        bit-identical to the sync path — only WHEN the host learns the
+        flag changes."""
+        flag = self._state.overflow if self.dynamic else None
+        self._state = self.update_scale(self._state)
+        return flag
+
     @property
     def state(self) -> LossScalerState:
         return self._state

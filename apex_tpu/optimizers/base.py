@@ -66,6 +66,7 @@ class FusedOptimizer:
         self.properties = None
         self._amp_wired = False
         self._skip_next_step = False
+        self._pending_overflow_flags = []  # deferred device-side flags
         self._pending_grads = None         # scaled, model-dtype grads
         self._stashed_grads = None         # for grad accumulation
         self._master_grads = None          # unscaled fp32 grads, step() input
@@ -291,6 +292,31 @@ class FusedOptimizer:
     def _arm_skip_step(self):
         self._skip_next_step = True
 
+    def _note_pending_overflow(self, flag, loss_id):
+        """Deferral hook for ``amp.scale_loss`` (see
+        ``LossScaler.update_scale_deferred``): stash the device-side
+        overflow flag; :meth:`step` reads every pending flag in ONE
+        stacked transfer and arms the one-shot skip if any fired."""
+        self._pending_overflow_flags.append((flag, loss_id))
+        if len(self._pending_overflow_flags) >= 64:
+            # An optimizer that keeps receiving backwards without ever
+            # stepping (frozen branch, aborted loop) must not hoard
+            # device buffers without bound — fold into the latch now.
+            self._resolve_pending_overflows()
+
+    def _resolve_pending_overflows(self):
+        if not self._pending_overflow_flags:
+            return
+        flags = [f for f, _ in self._pending_overflow_flags]
+        ids = [i for _, i in self._pending_overflow_flags]
+        self._pending_overflow_flags = []
+        vals = jax.device_get(jnp.stack(flags))       # ONE host round-trip
+        if bool(vals.any()):
+            self._skip_next_step = True
+            fired = [i for i, v in zip(ids, vals) if bool(v)]
+            maybe_print(f"Gradient overflow.  Skipping step "
+                        f"(loss scaler(s) {fired} reduced their scale)")
+
     # -- step ---------------------------------------------------------------
     def step(self, grads=None, closure=None):
         """Apply one update.  ``grads`` defaults to the amp-delivered master
@@ -298,6 +324,7 @@ class FusedOptimizer:
         param groups the grads structure is ``[grads_group0, ...]``."""
         if closure is not None:
             closure()
+        self._resolve_pending_overflows()
         if self._skip_next_step:
             # One-shot skip; clears itself like the reference's
             # self-restoring patched step (handle.py:126-151).

@@ -76,6 +76,11 @@ class FusedSGD(FusedOptimizer):
         super()._post_amp_backward(loss_scaler)
 
     def step(self, grads=None, closure=None):
+        # Deferred overflow flags must be read BEFORE the fast-path gate:
+        # scale_loss no longer arms _skip_next_step eagerly (the flag read
+        # is batched here), so the latch is still False at this point
+        # when an overflow is pending.
+        self._resolve_pending_overflows()
         if (grads is None and not self.materialize_master_grads
                 and self.master_params is not None
                 and self._master_grads is not None and not self._skip_next_step):

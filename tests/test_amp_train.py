@@ -309,3 +309,32 @@ def test_chain_steps_matches_per_call_trajectory():
     for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
                     jax.tree_util.tree_leaves(state_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_fused_sgd_no_materialize_skips_on_deferred_overflow():
+    """Regression (r4): scale_loss defers the overflow-flag read to
+    step(); FusedSGD's no-materialize FAST PATH must resolve the pending
+    flags before its gate, or an overflowed update would be applied
+    (the sync-era code armed the latch inside scale_loss, so the fast
+    path's `not _skip_next_step` check was then sufficient)."""
+    params = _init_params()
+    opt = FusedSGD(params, lr=0.1, momentum=0.9,
+                   materialize_master_grads=False)
+    params, opt = amp.initialize(params, opt, opt_level="O2",
+                                 loss_scale="dynamic", verbosity=0)
+    x, y = _batches(1)[0]
+    loss, grads = opt.value_and_grad(_loss_fn)(x, y)
+    with amp.scale_loss(loss, opt):
+        opt.backward(grads)
+    opt.step()
+    before = {k: np.asarray(v) for k, v in opt.master_params.items()}
+    scale_before = _amp_state.loss_scalers[0].loss_scale()
+    # Inf gradients -> deferred overflow flag -> step() must skip.
+    bad = jax.tree_util.tree_map(
+        lambda g: jnp.full_like(g, jnp.inf), grads)
+    with amp.scale_loss(loss, opt):
+        opt.backward(bad)
+    opt.step()
+    for k, v in opt.master_params.items():
+        np.testing.assert_array_equal(np.asarray(v), before[k], err_msg=k)
+    assert _amp_state.loss_scalers[0].loss_scale() == scale_before / 2
