@@ -158,18 +158,26 @@ def _force(tree):
     return float(jnp.ravel(leaves[-1])[0].astype(jnp.float32))
 
 
-def _time_steps(step, state, batch, iters, warmup=3):
+def _time_steps(step, state, batch, iters, warmup=3, reps=3):
     """Returns (seconds/step, final state) — the state is returned so
     callers can keep driving the step (e.g. under a profiler trace) after
-    the original buffers were consumed by ``donate_argnums``."""
+    the original buffers were consumed by ``donate_argnums``.
+
+    Min over ``reps`` timed passes: a single pass through the tunnel can
+    eat a multi-second stall (one r4 run recorded 2,635 ms/step against a
+    46.9 ms device time) — the best pass is what the chip demonstrably
+    does, the same policy as the flash timing and the calibration max."""
     for _ in range(warmup):
         state, m = step(state, batch)
     _force((m["loss"], state))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, batch)
-    _force((m["loss"], state))      # full chain: metrics AND final state
-    return (time.perf_counter() - t0) / iters, state
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, batch)
+        _force((m["loss"], state))  # full chain: metrics AND final state
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best, state
 
 
 def _time_steps_device_loop(step_fn, state, batch, k=8, calls=4, reps=3):
@@ -440,12 +448,14 @@ def _adam_fused_vs_eager(iters):
 
     p, s = run_fused(params, state)
     _force(p)
-    t0 = time.perf_counter()
-    p, s = params, state
-    for _ in range(iters):
-        p, s = run_fused(p, s)
-    _force(p)
-    t_fused = (time.perf_counter() - t0) / iters
+    t_fused = float("inf")
+    for _ in range(3):              # min-of-reps: the ~600-leaf arg
+        t0 = time.perf_counter()    # dispatch dominates this number and
+        p, s = params, state        # swings 1.5x pass-to-pass through
+        for _ in range(iters):      # the tunnel
+            p, s = run_fused(p, s)
+        _force(p)
+        t_fused = min(t_fused, (time.perf_counter() - t0) / iters)
 
     # eager: one dispatch per tensor (same math), jit per shape
     @jax.jit
@@ -472,12 +482,14 @@ def _adam_fused_vs_eager(iters):
 
     ps2, ms2, vs2 = run_eager(leaves_p, ms, vs, 1.0)   # compile all shapes
     _force(ps2)
-    t0 = time.perf_counter()
-    ps2, ms2, vs2 = leaves_p, ms, vs
-    for i in range(iters):
-        ps2, ms2, vs2 = run_eager(ps2, ms2, vs2, float(i + 1))
-    _force(ps2)
-    t_eager = (time.perf_counter() - t0) / iters
+    t_eager = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ps2, ms2, vs2 = leaves_p, ms, vs
+        for i in range(iters):
+            ps2, ms2, vs2 = run_eager(ps2, ms2, vs2, float(i + 1))
+        _force(ps2)
+        t_eager = min(t_eager, (time.perf_counter() - t0) / iters)
 
     return t_fused, t_eager, len(leaves_p)
 
