@@ -158,6 +158,16 @@ def _force(tree):
     return float(jnp.ravel(leaves[-1])[0].astype(jnp.float32))
 
 
+def _best_pass(pass_fn, reps=3):
+    """Min of ``reps`` calls to ``pass_fn() -> seconds_per_step`` — the
+    shared timing policy (see _time_steps for why single passes cannot be
+    trusted through the tunnel)."""
+    best = float("inf")
+    for _ in range(reps):
+        best = min(best, pass_fn())
+    return best
+
+
 def _time_steps(step, state, batch, iters, warmup=3, reps=3):
     """Returns (seconds/step, final state) — the state is returned so
     callers can keep driving the step (e.g. under a profiler trace) after
@@ -448,14 +458,18 @@ def _adam_fused_vs_eager(iters):
 
     p, s = run_fused(params, state)
     _force(p)
-    t_fused = float("inf")
-    for _ in range(3):              # min-of-reps: the ~600-leaf arg
-        t0 = time.perf_counter()    # dispatch dominates this number and
-        p, s = params, state        # swings 1.5x pass-to-pass through
-        for _ in range(iters):      # the tunnel
+
+    # min-of-reps (_best_pass): the ~600-leaf arg dispatch dominates this
+    # number and swings 1.5x pass-to-pass through the tunnel.
+    def fused_pass():
+        t0 = time.perf_counter()
+        p, s = params, state
+        for _ in range(iters):
             p, s = run_fused(p, s)
         _force(p)
-        t_fused = min(t_fused, (time.perf_counter() - t0) / iters)
+        return (time.perf_counter() - t0) / iters
+
+    t_fused = _best_pass(fused_pass)
 
     # eager: one dispatch per tensor (same math), jit per shape
     @jax.jit
@@ -482,14 +496,16 @@ def _adam_fused_vs_eager(iters):
 
     ps2, ms2, vs2 = run_eager(leaves_p, ms, vs, 1.0)   # compile all shapes
     _force(ps2)
-    t_eager = float("inf")
-    for _ in range(3):
+
+    def eager_pass():
         t0 = time.perf_counter()
         ps2, ms2, vs2 = leaves_p, ms, vs
         for i in range(iters):
             ps2, ms2, vs2 = run_eager(ps2, ms2, vs2, float(i + 1))
         _force(ps2)
-        t_eager = min(t_eager, (time.perf_counter() - t0) / iters)
+        return (time.perf_counter() - t0) / iters
+
+    t_eager = _best_pass(eager_pass)
 
     return t_fused, t_eager, len(leaves_p)
 
