@@ -192,21 +192,64 @@ class FusedOptimizer:
         for g in self.param_groups:
             g["lr"] = value
 
-    def value_and_grad(self, loss_fn: Callable, has_aux: bool = False):
+    def value_and_grad(self, loss_fn: Callable, has_aux: bool = False,
+                       jit: bool = True):
         """Return ``fn(*args) -> (loss, grads)`` differentiating the *scaled*
-        loss w.r.t. the model params (amp-aware).  Convenience for the
-        imperative loop; jit the result for speed."""
-        def scaled(params, *args):
+        loss w.r.t. the model params (amp-aware).
+
+        The returned ``fn`` is already jitted (``jit=False`` opts out for
+        non-jittable loss_fns); the CURRENT params and loss scale are
+        passed as jit *arguments* on every call.  Do NOT wrap the result
+        in another ``jax.jit``: an outer jit would capture the param tree
+        as trace-time constants, silently freezing the gradients at the
+        first step's weights (r5 fix — the DCGAN example did exactly
+        this for four rounds).
+
+        Hoist the call out of the training loop (``vg =
+        opt.value_and_grad(loss_fn)`` once, then ``vg(batch)`` per step).
+        Compiled functions are cached per ``loss_fn`` object, so a named
+        loss_fn stays cached even if you don't hoist — but a fresh lambda
+        per step would compile every iteration (the cache is identity-
+        keyed and bounded)."""
+        def plain(params, *args):
+            return loss_fn(params, *args)
+
+        def scaled(params, scale, *args):
             out = loss_fn(params, *args)
             loss = out[0] if has_aux else out
-            if self.loss_scaler is not None:
-                loss = self.loss_scaler.scale_loss(loss)
+            loss = jnp.asarray(loss, jnp.float32) * scale
             return (loss, out[1]) if has_aux else loss
 
-        vg = jax.value_and_grad(scaled, has_aux=has_aux)
+        # Cache the jitted pair per (loss_fn, has_aux, jit): the docs call
+        # ``opt.value_and_grad(loss_fn)(batch)`` INSIDE training loops, and
+        # a fresh jax.jit wrapper per call would retrace + recompile every
+        # step (code-review r5).
+        cache = getattr(self, "_vg_cache", None)
+        if cache is None:
+            cache = self._vg_cache = {}
+        key = (loss_fn, has_aux, jit)
+        if key in cache:
+            vg_plain, vg_scaled = cache[key]
+        else:
+            vg_plain = jax.value_and_grad(plain, has_aux=has_aux)
+            vg_scaled = jax.value_and_grad(scaled, has_aux=has_aux)
+            if jit:
+                vg_plain = jax.jit(vg_plain)
+                vg_scaled = jax.jit(vg_scaled)
+            if len(cache) >= 16:
+                # FIFO-bounded: a fresh-lambda-per-step caller must not
+                # leak a compiled pair (plus the lambda's captured batch
+                # arrays) per training iteration.
+                cache.pop(next(iter(cache)))
+            cache[key] = (vg_plain, vg_scaled)
 
         def fn(*args):
-            return vg(self.params, *args)
+            ls = self.loss_scaler
+            if ls is None or (not ls.dynamic and ls._initial_scale == 1.0):
+                # static scale 1.0: identity fast path, same program shape
+                # as the pre-amp world (reference handle.py:93-102)
+                return vg_plain(self.params, *args)
+            return vg_scaled(self.params, ls.state.loss_scale, *args)
         return fn
 
     def backward(self, grads):

@@ -141,7 +141,11 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
     * ``stage_params`` — this rank's ``[v, ...]`` slice of the
       ``[v, p, ...]`` round-robin stack built by
       :func:`stack_interleaved_stage_params` (shard axis 1 with
-      ``P(None, "pp")``); a kept axis of length 1 is squeezed.
+      ``P(None, "pp")``); a kept axis of length 1 is squeezed.  EVERY
+      leaf must carry the ``[v, 1, ...]`` leading axes — broadcast
+      leaves shared across stages are not supported (stack them into
+      the round-robin stack like any other leaf); an unstacked leaf
+      raises rather than passing through ambiguously (ADVICE r3/r4).
     * ``x`` — ``[batch, ...]`` replicated input; ``num_microbatches``
       must divide the batch, and the microbatch count must be a multiple
       of the pp axis size (the schedule fills the ring in groups of

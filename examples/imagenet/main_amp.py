@@ -135,6 +135,14 @@ def main():
         print(f"note: --prof {args.prof} rounded up to {rounded} "
               f"(multiple of --steps-per-call {spc})")
         args.prof = rounded
+    if spc > 1 and args.print_freq % spc:
+        # Same granularity rule for printing: the cadence below floors
+        # print_freq to whole calls, so a print_freq < spc would silently
+        # print (and pay the metric fetch) on EVERY call (ADVICE r4).
+        rounded = ((args.print_freq + spc - 1) // spc) * spc
+        print(f"note: --print-freq {args.print_freq} rounded up to "
+              f"{rounded} (multiple of --steps-per-call {spc})")
+        args.print_freq = rounded
     if spc > 1:
         # Device loop: scan spc steps per program.  The batch stack's
         # leading (step) axis stays unsharded; the per-step batch axis
@@ -224,8 +232,11 @@ def main():
     if n_done > warm:
         steady = (args.batch_size * (n_done - warm)
                   / (time.perf_counter() - t1))
+        # "first 2 calls", not "N compile iters": under the device loop
+        # the excluded window is 2*spc steps but only the two compiling
+        # CALLS, not 2*spc compile iterations (ADVICE r4).
         print(f"steady {steady:.1f} img/s over {n_done - warm} iters "
-              f"(excl {warm} compile iters)")
+              f"(excl first 2 calls)")
     print("done")
 
 

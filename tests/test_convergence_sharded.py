@@ -1,0 +1,18 @@
+"""Slow suite wrapper for the sharded trajectory gates (VERDICT r4 next
+#7): dp × tp and ZeRO-1 vs the same program on a 1-device mesh, at
+reduced depth for CI (the driver artifact runs 120 steps via
+``tools/convergence_sharded.py``)."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_sharded_trajectories_track_single():
+    from tools.convergence_sharded import run_gates
+    art = run_gates(steps=60, log_every=0)
+    for topo, v in art["verdicts"].items():
+        assert v["o0"]["ok"], (topo, v["o0"])
+        assert v["o2"]["ok"], (topo, v["o2"])
+        assert v["o0"]["head_max_rel"] < 2e-3
+    assert art["ok"]

@@ -280,8 +280,11 @@ def test_flash_attention_sublane_only_shape_on_chip():
     q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
                for _ in range(3))
     with jax.default_device(_tpu_dev()):
+        # explicit blocks force the KERNEL (the r5 shape dispatch would
+        # otherwise route this sub-crossover shape to the jnp path);
+        # whole-array 136-blocks still exercise the sublane rule.
         out = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=True))(q, k, v)
+            q, k, v, causal=True, block_q=136, block_k=136))(q, k, v)
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
@@ -383,8 +386,12 @@ def test_flash_2d_bias_kernels_on_chip():
 
 def test_tp_self_attention_flash_kernel_on_chip():
     """dp x tp style head-parallel attention on a 1-device tp mesh under
-    DEFAULT shard_map: the default attention_fn must run the Mosaic flash
-    kernel (jnp fallback forbidden) and match the dense reference."""
+    DEFAULT shard_map: the DEFAULT attention_fn must run the Mosaic flash
+    kernel (jnp fallback forbidden) and match the dense reference.  T is
+    above the r5 shape-dispatch crossover so the default path really is
+    the kernel path here; the dispatch itself (sub-crossover shapes
+    routing to jnp) is covered by test_flash_dispatch_* in
+    tests/test_flash_attention.py."""
     import apex_tpu.ops.flash_attention as fa
     from jax.sharding import Mesh, PartitionSpec as P
     from jax import shard_map
@@ -392,7 +399,7 @@ def test_tp_self_attention_flash_kernel_on_chip():
     from apex_tpu.parallel.tensor_parallel import tp_self_attention
 
     rng = np.random.RandomState(5)
-    B, T, d, H, hd = 2, 256, 64, 4, 32
+    B, T, d, H, hd = 2, max(1024, fa._KERNEL_MIN_KV), 64, 4, 32
     x = jnp.asarray(rng.randn(B, T, d) * .5, jnp.float32)
     wqkv = jnp.asarray(rng.randn(d, 3, H, hd) * .2, jnp.float32)
     wo = jnp.asarray(rng.randn(H * hd, d) * .2, jnp.float32)

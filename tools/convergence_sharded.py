@@ -1,0 +1,285 @@
+"""Deep trajectory gates for SHARDED topologies (VERDICT r4 next #7).
+
+``tools/convergence.py`` proves 8-way DP tracks single-process at 150-step
+depth; the five other topologies in ``__graft_entry__.dryrun_multichip``
+run one step each.  This tool trains two of them — dp × tp
+(Megatron-style tensor parallelism, ``apex_tpu/parallel/
+tensor_parallel.py``) and ZeRO-1 (optimizer-state sharding,
+``apex_tpu/parallel/zero.py``) — for 100+ steps on the virtual CPU mesh
+and gates the loss trajectory against the SAME shard_map program on a
+1-device mesh (the honest single-process oracle: identical code path,
+only the mesh factorization differs, so the comparison isolates
+sharding/reduction order exactly like the DP gate).
+
+Two-tier structure (same rationale as ``convergence.gate_dp``):
+
+* O0 / fp32: per-step head gate at near-reduction-order tolerance.
+* O2 / bf16: statistical tail gate only (bf16 amplifies epsilon-level
+  reduction-order differences chaotically; see the r5 DP controls).
+
+Run::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/convergence_sharded.py --steps 120 \
+      --out CONVERGENCE_SHARDED_r05.json
+
+Reference anchor: the L1 cross-product-distributed suite
+(``/root/reference/tests/L1/cross_product_distributed/run.sh``) trains
+real epochs under DDP; these gates are its analog for the beyond-parity
+topologies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os as _os
+import sys as _sys
+import time
+
+import numpy as np
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), _os.pardir)))
+
+try:
+    from tools.convergence import gate_dp  # imported as a package module
+except ImportError:
+    from convergence import gate_dp        # run as a script from tools/
+
+
+def _cpu_devices(n):
+    import jax
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        raise SystemExit(
+            f"need {n} CPU devices, found {len(devs)} — run with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
+    return devs[:n]
+
+
+def run_dp_tp(opt_level, steps, *, dp, tp, batch=32, seq=16, log_every=50):
+    """One loss curve of the toy transformer under dp × tp sharding.
+    ``dp=tp=1`` is the single-process oracle (same program, 1-device
+    mesh)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import training
+    from apex_tpu.parallel import tp_mlp, tp_self_attention
+    from apex_tpu.training import make_train_step
+
+    V, D, H, E, C = 256, 64, 4, 16, 10
+    rng = np.random.RandomState(0)
+    params = {
+        "emb": jnp.asarray(rng.randn(V, D) * 0.05, jnp.float32),
+        "wqkv": jnp.asarray(rng.randn(D, 3, H, E) * 0.05, jnp.float32),
+        "wo": jnp.asarray(rng.randn(H * E, D) * 0.05, jnp.float32),
+        "w1": jnp.asarray(rng.randn(D, 4 * D) * 0.05, jnp.float32),
+        "b1": jnp.zeros((4 * D,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(4 * D, D) * 0.05, jnp.float32),
+        "b2": jnp.zeros((D,), jnp.float32),
+        "head": jnp.asarray(rng.randn(D, C) * 0.05, jnp.float32),
+    }
+    pspec = {
+        "emb": P(), "wqkv": P(None, None, "tp"), "wo": P("tp", None),
+        "w1": P(None, "tp"), "b1": P("tp"), "w2": P("tp", None),
+        "b2": P(), "head": P(),
+    }
+    dtype = jnp.bfloat16 if opt_level in ("O2", "O3") else jnp.float32
+
+    def loss_fn(p, batch_):
+        ids, y = batch_
+        x = p["emb"][ids].astype(dtype)
+        x = x + tp_self_attention(x, p["wqkv"], p["wo"],
+                                  H // tp, "tp", causal=True)
+        x = x + tp_mlp(x, p["w1"], p["b1"], p["w2"], p["b2"], "tp")
+        # first-token (CLS-style) pooling: the label is a function of
+        # ids[:, 0], so it is linearly decodable from x[:, 0] and the
+        # curve actually falls at gate depth (mean pooling diluted the
+        # signal 1/seq and the loss sat at ~ln C for 120 steps)
+        logits = x[:, 0].astype(jnp.float32) @ p["head"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    tx = training.sgd(lr=0.1, momentum=0.9)
+    init_fn, step_fn = make_train_step(
+        loss_fn, tx, opt_level=opt_level,
+        loss_scale="dynamic" if opt_level == "O2" else None,
+        axis_name=("data",))
+    state = init_fn(params)
+
+    devices = _cpu_devices(dp * tp)
+    mesh = Mesh(np.array(devices).reshape(dp, tp), ("data", "tp"))
+    # TrainState spec: params (and every optimizer-state subtree that
+    # mirrors them — masters, momentum) carry the tp sharding; scalars
+    # stay replicated.  Same scaffold as __graft_entry__._run_step_on_mesh.
+    from apex_tpu.training import TrainState
+    params_struct = jax.tree_util.tree_structure(state.params)
+
+    def spec_of(node):
+        if jax.tree_util.tree_structure(node) == params_struct:
+            return pspec
+        if hasattr(node, "_fields"):
+            return type(node)(*[spec_of(getattr(node, f))
+                                for f in node._fields])
+        return P()
+
+    state_spec = TrainState(params=pspec, opt_state=spec_of(state.opt_state),
+                            scaler=P(), model_state=P())
+
+    step = jax.jit(shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(state_spec, (P("data"), P("data"))),
+        out_specs=(state_spec, P())), donate_argnums=(0,))
+
+    n_batches = 8
+    xs = [jnp.asarray(rng.randint(0, V, (batch, seq))) for _ in
+          range(n_batches)]
+    # Labels derived FROM the sequence (first token id mod C): a learnable
+    # structured task — random labels on random sequences were not
+    # memorizable by the 1-layer model at gate depth, leaving the
+    # "learned" criterion vacuously red.
+    ys = [x[:, 0] % C for x in xs]
+    losses = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, (xs[i % n_batches], ys[i % n_batches]))
+        losses.append(jnp.ravel(metrics["loss"])[0])
+        if log_every and i % log_every == 0:
+            print(f"  [dp{dp}xtp{tp}/{opt_level}] step {i} "
+                  f"loss {float(losses[-1]):.4f}", flush=True)
+    return ([float(v) for v in np.asarray(jnp.stack(losses))],
+            time.perf_counter() - t0)
+
+
+def run_zero1(opt_level, steps, *, shards, batch=64, log_every=50):
+    """One loss curve of a 3-layer MLP under ZeRO-1 optimizer-state
+    sharding over ``shards`` devices; ``shards=1`` is the oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import training
+    from apex_tpu.parallel.zero import zero1, zero1_partition_spec
+    from apex_tpu.training import make_train_step
+
+    Din, Dh, C = 64, 128, 10
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(Din, Dh) * 0.1, jnp.float32),
+        "b1": jnp.zeros((Dh,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(Dh, Dh) * 0.1, jnp.float32),
+        "b2": jnp.zeros((Dh,), jnp.float32),
+        "w3": jnp.asarray(rng.randn(Dh, C) * 0.1, jnp.float32),
+        "b3": jnp.zeros((C,), jnp.float32),
+    }
+    dtype = jnp.bfloat16 if opt_level in ("O2", "O3") else jnp.float32
+
+    def loss_fn(p, batch_):
+        x, y = batch_
+        h = jax.nn.relu(x.astype(dtype) @ p["w1"].astype(dtype)
+                        + p["b1"].astype(dtype))
+        h = jax.nn.relu(h @ p["w2"].astype(dtype) + p["b2"].astype(dtype))
+        logits = (h @ p["w3"].astype(dtype)).astype(jnp.float32) + p["b3"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    tx = zero1(training.adam(1e-2), "data", num_shards=shards)
+    init_fn, step_fn = make_train_step(
+        loss_fn, tx, opt_level=opt_level,
+        loss_scale="dynamic" if opt_level == "O2" else None,
+        axis_name="data")
+    state = init_fn(params)
+
+    devices = _cpu_devices(shards)
+    mesh = Mesh(np.array(devices), ("data",))
+    from apex_tpu.training import TrainState
+    zspec = zero1_partition_spec(state.opt_state, "data")
+    state_spec = TrainState(params=P(), opt_state=zspec,
+                            scaler=P(), model_state=P())
+
+    step = jax.jit(shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(state_spec, (P("data"), P("data"))),
+        out_specs=(state_spec, P())), donate_argnums=(0,))
+
+    n_batches = 8
+    xs = [jnp.asarray(rng.randn(batch, Din), jnp.float32) for _ in
+          range(n_batches)]
+    ys = [jnp.asarray(rng.randint(0, C, (batch,))) for _ in
+          range(n_batches)]
+    losses = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, (xs[i % n_batches], ys[i % n_batches]))
+        losses.append(jnp.ravel(metrics["loss"])[0])
+        if log_every and i % log_every == 0:
+            print(f"  [zero1x{shards}/{opt_level}] step {i} "
+                  f"loss {float(losses[-1]):.4f}", flush=True)
+    return ([float(v) for v in np.asarray(jnp.stack(losses))],
+            time.perf_counter() - t0)
+
+
+def run_gates(steps, *, dp=4, tp=2, zero_shards=8, head=6, tail=30,
+              log_every=50):
+    """All four curve pairs + two-tier verdicts; returns the artifact."""
+    import jax
+    cpu0 = _cpu_devices(1)[0]
+    out = {"config": {"steps": steps, "dp": dp, "tp": tp,
+                      "zero_shards": zero_shards,
+                      "backend": "cpu (virtual mesh)"}}
+    verdicts = {}
+    with jax.default_device(cpu0):
+        for topo in ("dp_tp", "zero1"):
+            curves = {}
+            for lvl in ("O0", "O2"):
+                if topo == "dp_tp":
+                    curves[f"{lvl}_single"], _ = run_dp_tp(
+                        lvl, steps, dp=1, tp=1, log_every=log_every)
+                    curves[f"{lvl}_sharded"], _ = run_dp_tp(
+                        lvl, steps, dp=dp, tp=tp, log_every=log_every)
+                else:
+                    curves[f"{lvl}_single"], _ = run_zero1(
+                        lvl, steps, shards=1, log_every=log_every)
+                    curves[f"{lvl}_sharded"], _ = run_zero1(
+                        lvl, steps, shards=zero_shards,
+                        log_every=log_every)
+            v = {
+                "o0": gate_dp(curves["O0_single"], curves["O0_sharded"],
+                              head=head, tail=tail, head_gate=True),
+                "o2": gate_dp(curves["O2_single"], curves["O2_sharded"],
+                              head=head, tail=tail, head_gate=False),
+            }
+            v["ok"] = v["o0"]["ok"] and v["o2"]["ok"]
+            verdicts[topo] = v
+            out[f"losses_{topo}"] = {k: [round(x, 5) for x in c]
+                                     for k, c in curves.items()}
+    out["verdicts"] = verdicts
+    out["ok"] = all(v["ok"] for v in verdicts.values())
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    art = run_gates(args.steps)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(art, f)
+    print(json.dumps({"sharded_convergence_ok": art["ok"],
+                      **{k: v["ok"] for k, v in art["verdicts"].items()}}))
+    if not art["ok"]:
+        raise SystemExit("SHARDED CONVERGENCE GATE FAILED")
+
+
+if __name__ == "__main__":
+    main()
