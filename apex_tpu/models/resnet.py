@@ -15,6 +15,7 @@ import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..parallel import SyncBatchNorm
@@ -30,18 +31,26 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # checkpoint_name is an identity outside jax.checkpoint; under
+        # ResNet(remat="conv_out") the policy saves exactly these values
+        # and recomputes the BN/ReLU chain from them in the backward.
+        from jax.ad_checkpoint import checkpoint_name
         residual = x
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = checkpoint_name(y, "conv_out")
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
         y = self.conv(self.filters, (3, 3), self.strides, name="conv2")(y)
+        y = checkpoint_name(y, "conv_out")
         y = self.norm(name="bn2")(y)
         y = nn.relu(y)
         y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = checkpoint_name(y, "conv_out")
         y = self.norm(name="bn3", scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters * 4, (1, 1), self.strides,
                                  name="downsample_conv")(residual)
+            residual = checkpoint_name(residual, "conv_out")
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(residual + y)
 
@@ -77,6 +86,16 @@ class ResNet(nn.Module):
     axis_name: Optional[str] = None
     bn_process_group: Optional[Sequence[Sequence[int]]] = None
     bn_momentum: float = 0.1
+    # Rematerialization per residual block (jax.checkpoint), an HBM-
+    # traffic experiment knob for the bandwidth-bound O2 step (~93% of
+    # HBM peak, MXU ~25% busy — r5 bytes ledger):
+    #   False      — save everything (XLA default; measured 46.9 ms dev)
+    #   "full"     — nothing_saveable: recompute whole blocks from their
+    #                inputs.  Measured WORSE (57.8 ms dev, conv traffic
+    #                28.0 -> 30.2 GB): the recompute is itself convs.
+    #   "conv_out" — save only conv outputs; recompute the BN/ReLU
+    #                elementwise chains from them in the backward.
+    remat: Any = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -99,12 +118,26 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = self.block_cls
+        if self.remat:
+            # `train` reaches the block through the norm partials
+            # (closure), so the block itself takes only x.
+            if self.remat == "conv_out":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "conv_out")
+            elif self.remat in (True, "full"):
+                policy = jax.checkpoint_policies.nothing_saveable
+            else:
+                raise ValueError(
+                    f"remat must be False, 'full', or 'conv_out'; got "
+                    f"{self.remat!r}")
+            block_cls = nn.remat(block_cls, policy=policy)
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(self.num_filters * 2 ** i, strides,
-                                   conv=conv, norm=norm,
-                                   name=f"stage{i + 1}_block{j + 1}")(x)
+                x = block_cls(self.num_filters * 2 ** i, strides,
+                              conv=conv, norm=norm,
+                              name=f"stage{i + 1}_block{j + 1}")(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32, name="head")(x)

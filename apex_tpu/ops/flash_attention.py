@@ -74,11 +74,15 @@ _DEFAULT_BLOCK_K = 1024
 # kernels LOSE to one fused XLA softmax over materialized scores — the
 # per-launch overhead and block machinery cannot amortize (BERT seq 128:
 # 27.7% of the device step was zero-attributed custom-calls).  Measured
-# crossover on the v5e (tools/attention_sweep.py -> ATTENTION_SWEEP.json):
-# the kernel wins from kv_len >= _KERNEL_MIN_KV; below it flash_attention
-# with DEFAULT block sizes routes to the jnp path, which computes the
-# same function.  Passing block_q/block_k explicitly always forces the
-# kernel (the escape hatch, same contract as the bias cap above).
+# crossover on the v5e (tools/attention_sweep.py -> ATTENTION_SWEEP.json,
+# 15 configs over seq x head_dim x batch*heads x causal): below 1024 the
+# jnp path wins or ties within tunnel noise (e.g. causal b16 s512: jnp
+# 9.7 ms vs kernel-best 12.4); from 1024 the kernel wins decisively
+# (causal b16 s1024: 12.4 vs 21.6; s2048: 18.8 vs 47.7; 1024^2 blocks
+# best at every winning shape).  flash_attention with DEFAULT (None)
+# block sizes routes sub-crossover shapes to the jnp path, which computes
+# the same function; passing block_q/block_k explicitly always forces
+# the kernel (the escape hatch, same contract as the bias cap).
 _KERNEL_MIN_KV = 1024
 
 

@@ -47,6 +47,9 @@ class KernelRecord(NamedTuple):
     category: str = ""     # XLA hlo_category, e.g. "convolution fusion"
     model_flops: float = 0.0
     bytes_accessed: float = 0.0
+    # full HLO instruction text ("%x = bf16[128,56,56,64]{...} fusion(...)")
+    # — carries operand/output shapes for shape-signature attribution
+    long_name: str = ""
 
 
 _WRAP_RE = re.compile(r"^(?:wrapped_|fusion_)?(.*?)(?:\.\d+)?$")
@@ -194,7 +197,8 @@ def parse_trace(logdir: str, module_filter: Optional[str] = None
                     device=str(e.get("pid", "")),
                     category=str(args.get("hlo_category", "")),
                     model_flops=float(args.get("model_flops") or 0.0),
-                    bytes_accessed=float(args.get("bytes_accessed") or 0.0)))
+                    bytes_accessed=float(args.get("bytes_accessed") or 0.0),
+                    long_name=str(args.get("long_name", ""))))
     records.sort(key=lambda r: r.start_us)
     return TraceProfile(records)
 

@@ -228,7 +228,10 @@ def _time_steps_device_loop(step_fn, state, batch, k=8, calls=4, reps=3):
     return best
 
 
-def _prof_top_ops(step, state, batch, steps=3, top=5):
+_PROF_TRACE_STEPS = 3   # shared with the bytes ledger below
+
+
+def _prof_top_ops(step, state, batch, steps=_PROF_TRACE_STEPS, top=5):
     """Dogfood the profiler on a headline workload (VERDICT r2 next #3):
     capture a real XLA device trace around ``steps`` executions with
     :func:`apex_tpu.prof.capture.trace`, parse it with
@@ -261,7 +264,7 @@ def _prof_top_ops(step, state, batch, steps=3, top=5):
             _force((m["loss"], s))
         tp = prof_parse.parse_trace(logdir)
         if not tp.records:
-            return {"error": "trace produced no device events"}
+            return {"error": "trace produced no device events"}, None
         ops = sorted(tp.by_op().items(), key=lambda kv: -kv[1]["total_us"])
         by_cat = [
             {"category": k, "count": v["count"],
@@ -281,9 +284,9 @@ def _prof_top_ops(step, state, batch, steps=3, top=5):
                  "mean_us": round(agg["mean_us"], 2)}
                 for name, agg in ops[:top]],
             "by_category": by_cat,
-        }
+        }, tp
     except Exception as e:               # never fail the bench on prof
-        return {"error": f"{type(e).__name__}: {e}"}
+        return {"error": f"{type(e).__name__}: {e}"}, None
     finally:
         shutil.rmtree(logdir, ignore_errors=True)
 
@@ -813,7 +816,27 @@ def main():
     # it; the copy seeds the device-loop timing below.
     state_dl = jax.tree_util.tree_map(jnp.copy, state2)
     t_o2, state2 = _time_steps(step2, state2, data2, iters)
-    prof_resnet = _prof_top_ops(step2, state2, data2) if on_tpu else None
+    prof_resnet, tp_resnet = (_prof_top_ops(step2, state2, data2)
+                              if on_tpu else (None, None))
+    # Bytes ledger (VERDICT r4 next #1): measured fusion traffic from the
+    # trace just captured vs the model-intrinsic traffic of the SAME step
+    # (conv/dot operands+outputs at their dtypes + optimizer-side bytes)
+    # — the number that says whether "roofline-bound" is the model's
+    # fault or the schedule's.
+    ledger_resnet = None
+    if tp_resnet is not None:
+        try:
+            from apex_tpu.prof.ledger import bytes_ledger
+            n_par = int(sum(np.prod(l.shape) for l in
+                            jax.tree_util.tree_leaves(state2.params)))
+            ledger_resnet = bytes_ledger(
+                step_fn2, (state2, data2), tp_resnet,
+                steps=_PROF_TRACE_STEPS, n_params=n_par, optimizer="sgd")
+            # keep the JSON small: top-10 intrinsic layers only
+            ledger_resnet["intrinsic"]["by_layer"] = (
+                ledger_resnet["intrinsic"]["by_layer"][:10])
+        except Exception as e:           # never fail the bench on prof
+            ledger_resnet = {"error": f"{type(e).__name__}: {e}"}
     t_o2_dl = (_time_steps_device_loop(step_fn2, state_dl, data2)
                if on_tpu else t_o2)
     del step2, state2, data2, state_dl
@@ -845,7 +868,8 @@ def main():
      hidden, vocab, bstep_fn) = _make_bert_step(b_batch, b_seq)
     bstate_dl = jax.tree_util.tree_map(jnp.copy, bstate)
     t_bert, bstate = _time_steps(bstep, bstate, bdata, max(iters // 2, 2))
-    prof_bert = _prof_top_ops(bstep, bstate, bdata) if on_tpu else None
+    prof_bert, _tp_b = (_prof_top_ops(bstep, bstate, bdata)
+                       if on_tpu else (None, None))
     t_bert_dl = (_time_steps_device_loop(bstep_fn, bstate_dl, bdata, k=16)
                  if on_tpu else t_bert)
     del bstep, bstate, bdata, bstate_dl
@@ -934,6 +958,8 @@ def main():
             # prof dogfood: measured per-op device time for this exact
             # step, via prof.capture.trace + prof.parse.parse_trace.
             "prof_measured": prof_resnet,
+            # measured vs intrinsic HBM traffic (prof.ledger)
+            "bytes_ledger": ledger_resnet,
             # O2 cast + unscale + masked-SGD update measured as their own
             # on-device program over the same tree (see
             # _measure_precision_plumbing): what the precision machinery

@@ -1,0 +1,84 @@
+"""Unit tests for the bytes ledger (apex_tpu/prof/ledger.py, VERDICT r4
+next #1): analytic intrinsic counting, bridge detection, and the shape
+signature used for the measured join."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.prof.ledger import (_bridge_bytes, _spatial_sig,
+                                  intrinsic_by_shape, intrinsic_ledger)
+
+
+def test_intrinsic_counts_dot_operands_and_outputs():
+    a = jnp.zeros((128, 256), jnp.bfloat16)
+    b = jnp.zeros((256, 512), jnp.bfloat16)
+
+    def f(a, b):
+        return a @ b
+
+    led = intrinsic_ledger(f, a, b)
+    # operands + output at bf16: (128*256 + 256*512 + 128*512) * 2 bytes
+    want = (128 * 256 + 256 * 512 + 128 * 512) * 2 / 1e9
+    assert abs(led["compute_gb"] - round(want, 3)) < 1e-3
+    assert led["optimizer_gb"] == 0.0
+
+
+def test_intrinsic_optimizer_term():
+    a = jnp.zeros((8, 8), jnp.bfloat16)
+    led = intrinsic_ledger(lambda x: x @ x, a, n_params=1000,
+                           optimizer="adam")
+    assert led["optimizer_gb"] == round(1000 * 30 / 1e9, 3)
+
+
+def test_bridge_detects_distant_consumer():
+    # y is produced at eqn 0 and consumed ~200 elementwise eqns later:
+    # it must spill.  The chain itself is producer-consumer fusable and
+    # must NOT be counted.
+    def f(x):
+        y = jnp.sin(x)
+        z = y
+        for _ in range(200):
+            z = z + 1.0
+        return z + y          # distant read of y
+
+    x = jnp.zeros((256, 256), jnp.float32)
+    b = _bridge_bytes(f, x, gap=100)
+    want_gb = 256 * 256 * 4 * 2 / 1e9          # one write + one read
+    assert abs(b["gb"] - round(want_gb, 3)) < 2e-3, b
+
+
+def test_bridge_excludes_conv_operands():
+    # the distant consumer is a dot_general: its read is charged by the
+    # compute ledger, so the bridge must NOT double-count it.
+    def f(x):
+        y = jnp.sin(x)
+        z = y
+        for _ in range(200):
+            z = z + 1.0
+        return z @ y
+
+    x = jnp.zeros((128, 128), jnp.float32)
+    b = _bridge_bytes(f, x, gap=100)
+    assert b["gb"] == 0.0, b
+
+
+def test_spatial_sig_picks_largest_nhwc():
+    # 128*56*56*64 (25.7M elems) > 128*112*112*3 (4.8M): the larger
+    # tensor's spatial dim wins, kernels (7,7,3,64) never do.
+    ln = ("%f = (f32[64]{0}, bf16[128,56,56,64]{...}) fusion("
+          "bf16[128,112,112,3]{...} %p0, bf16[7,7,3,64]{...} %p1)")
+    assert _spatial_sig(ln) == "hw56"
+    assert _spatial_sig("%a = f32[8]{0} add(...)") == "other"
+
+
+def test_intrinsic_by_shape_groups_convs():
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    x = jnp.zeros((2, 16, 16, 8), jnp.bfloat16)
+    w = jnp.zeros((3, 3, 8, 8), jnp.bfloat16)
+    rows = intrinsic_by_shape(f, x, w)
+    assert "hw16" in rows and rows["hw16"]["count"] == 1
