@@ -41,6 +41,14 @@ def _load():
     with _lock:
         if _lib is not None or available is None:
             return _lib
+        if os.environ.get("APEX_TPU_DISABLE_NATIVE"):
+            # Force the Python tier (install-matrix / docker/run_matrix.sh
+            # tiers 2 and 4): without this the lazy builder would simply
+            # rebuild a deleted .so whenever g++ is present, making a
+            # "no-native" tier silently native again.
+            available = False
+            _lib = False
+            return None
         path = _SO if os.path.exists(_SO) else _build()
         if path is None:
             available = False

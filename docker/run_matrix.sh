@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Install/tier matrix — the runnable analog of the reference's
+# tests/docker_extension_builds/run.sh:16-40 (build apex across ~7 images
+# and assert each tier works).  The TPU build's matrix is degradation
+# tiers rather than CUDA/toolchain images:
+#
+#   tier 1: full        — native C++ runtime + Pallas kernels
+#   tier 2: no-native   — Python flatten/decode fallbacks
+#   tier 3: no-pallas   — jnp kernels (APEX_TPU_DISABLE_PALLAS=1)
+#   tier 4: bare        — both fallbacks at once
+#
+# Each tier runs the install-matrix gate (tier-equivalence tests) plus an
+# import smoke.  Run from the repo root; ~5 min on an 8-core box.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+FAST="python -m pytest tests/test_install_matrix.py -q"
+
+echo "=== tier 1: full (native + pallas) ==="
+python setup.py build_native
+$FAST
+
+echo "=== tier 2: no-native (python flatten/decode) ==="
+# APEX_TPU_DISABLE_NATIVE short-circuits the lazy builder (which would
+# otherwise just rebuild the .so with the g++ tier 1 proved present)
+APEX_TPU_DISABLE_NATIVE=1 $FAST
+
+echo "=== tier 3: no-pallas (jnp kernels) ==="
+APEX_TPU_DISABLE_PALLAS=1 $FAST
+
+echo "=== tier 4: bare (both fallbacks) ==="
+APEX_TPU_DISABLE_NATIVE=1 APEX_TPU_DISABLE_PALLAS=1 $FAST
+
+echo "=== import smoke from outside the tree ==="
+(cd /tmp && PYTHONPATH="$OLDPWD" python -c "
+import apex_tpu
+from apex_tpu import amp, optimizers, parallel, normalization
+print('import surface ok:', apex_tpu.__name__)")
+
+echo "ALL TIERS GREEN"

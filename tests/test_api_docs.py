@@ -1,0 +1,11 @@
+"""Drift gate for the generated API reference (VERDICT r4 missing #3):
+docs/api/*.md must match the code's public symbols and docstrings."""
+
+import pytest
+
+
+@pytest.mark.slow          # imports every public module; ~10 s on CPU
+def test_api_reference_matches_code():
+    from tools.gen_api_docs import main
+    assert main(check=True), (
+        "docs/api drifted — regenerate with python tools/gen_api_docs.py")

@@ -197,7 +197,17 @@ def gate_dp(losses_single, losses_dp, *, head=6, tail=30,
       NOT honest under bf16 — a 1e-7 stat difference flips bf16
       quantization boundaries in the activations (measured 2.6e-5 loss
       difference at step 0, 0.03 by step 10 on this harness), so only
-      the statistical criterion applies.
+      the statistical criterion applies.  PROVEN by the r5 controls
+      (``--o2-controls``, ``CONVERGENCE_DP_r05.json``): (a) the
+      ``allreduce_always_fp32`` run is bit-identical to the plain DP run
+      (grads are fp32 masters pre-summed by shard_map's implicit psum —
+      allreduce dtype ruled out); (b) the step-0 single-vs-DP gap, where
+      no optimizer or allreduce has executed, is 1.0e-7 in fp32 vs
+      2.5e-5 in bf16 — pure forward reduction order, amplified ~250x by
+      bf16 quantization; (c) a 1e-7 relative INPUT epsilon produces a
+      head divergence of 0.0198 — 2.6x LARGER than the observed DP gap
+      (0.0075), so the gap sits well inside the chaos envelope of any
+      epsilon-level difference.
 
     Both tiers require tail-mean agreement within ``tail_tol`` and the
     DP run actually learning."""
