@@ -667,6 +667,7 @@ _STEADY_RE = re.compile(r"steady ([\d.]+) img/s over (\d+) iters")
 _DCGAN_RE = re.compile(r"Loss_D: ([\d.infa+-]+) Loss_G: ([\d.infa+-]+)")
 _DONE_RE = re.compile(r"done in ([\d.]+)s \(([\d.]+) it/s\)")
 _DCGAN_STEADY_RE = re.compile(r"steady ([\d.]+) it/s over (\d+) iters")
+_DCGAN_BEST_RE = re.compile(r"best-of-3 windows: ([\d.]+) it/s")
 
 
 def _run_example(rel_path, argv, timeout=2400):
@@ -763,9 +764,14 @@ def _bench_examples(on_tpu):
     flat = [v for p in pairs for v in p]
     if not all(np.isfinite(flat)):
         raise SystemExit(f"BENCH EXAMPLE FAILED: dcgan non-finite losses")
+    best = _DCGAN_BEST_RE.search(stdout)
     out["dcgan_main_amp_imperative_3scaler"] = {
         "argv": " ".join(args),
         "it_per_sec_incl_compile": float(done.group(2)),
+        # min-of-reps policy applied to the imperative loop: the rate the
+        # loop demonstrably achieves (single windows eat tunnel stalls;
+        # device work is ~2 ms/iter)
+        "it_per_sec_best_window": (float(best.group(1)) if best else None),
         # compile-excluded rate the example prints itself (VERDICT r3
         # next #6); still pays the imperative path's 3 scaler host-syncs
         # per iteration — the fused joint step is benched separately in

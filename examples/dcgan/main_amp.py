@@ -46,9 +46,11 @@ def parse():
                    help="pre-staged synthetic batches reused cyclically "
                    "(host->device upload happens before the timed loop, "
                    "like a prefetching input pipeline)")
-    p.add_argument("--warmup", type=int, default=2,
-                   help="iters excluded from the steady-state rate "
-                   "(jit compiles happen in the first iterations)")
+    p.add_argument("--warmup", type=int, default=4,
+                   help="iters excluded from the steady-state rate (the "
+                   "first iterations compile; the SECOND call of each "
+                   "program can retrace too — jit caches on input "
+                   "shardings, and step outputs come back committed)")
     return p.parse_args()
 
 
@@ -141,35 +143,51 @@ def main():
              jnp.asarray(rng.randn(opt.batchSize, opt.nz), jnp.float32))
             for _ in range(max(1, opt.data_pool))]
 
+    def train_iter(idx):
+        """One imperative iteration — shared by the main loop AND the
+        best-of-3 timing windows so both measure the same computation.
+        Returns the (scaled) losses and the scales used."""
+        real, noise = pool[idx % len(pool)]
+        # (1) D phase: ONE program — G fwd (detached) + D-real + D-fake
+        # backwards; separate scalers per loss (loss_id=0/1).
+        s0, s1 = live_scale(0), live_scale(1)
+        errD_real, gD, errD_fake, gDf = d_phase(
+            optimizerD.params, optimizerG.params, real, noise, s0, s1)
+        with amp.scale_loss(errD_real, optimizerD, loss_id=0):
+            optimizerD.backward(gD)
+        with amp.scale_loss(errD_fake, optimizerD, loss_id=1):
+            optimizerD.backward(gDf)
+        optimizerD.step()
+        # (2) G, loss_id=2 (grads w.r.t. G through D)
+        s2 = live_scale(2)
+        errG, gG = g_phase(optimizerG.params, optimizerD.params, noise, s2)
+        with amp.scale_loss(errG, optimizerG, loss_id=2):
+            optimizerG.backward(gG)
+        optimizerG.step()
+        return errD_real, errD_fake, errG, s0, s1, s2
+
+    def drain():
+        """Force the pipeline: one scalar fetch of the LAST update's
+        output (block_until_ready is a no-op through the tunnel)."""
+        float(jnp.ravel(jax.tree_util.tree_leaves(
+            optimizerG.params)[-1])[0].astype(jnp.float32))
+
     t0 = time.perf_counter()
     total = opt.niter * opt.iters_per_epoch
     t_steady = t0 if opt.warmup <= 0 else None
     it = 0
     for epoch in range(opt.niter):
         for i in range(opt.iters_per_epoch):
-            real, noise = pool[it % len(pool)]
-
-            # (1) D phase: ONE program — G fwd (detached) + D-real +
-            # D-fake backwards; separate scalers per loss (loss_id=0/1).
-            s0, s1 = live_scale(0), live_scale(1)
-            errD_real, gD, errD_fake, gDf = d_phase(
-                optimizerD.params, optimizerG.params, real, noise, s0, s1)
-            with amp.scale_loss(errD_real, optimizerD, loss_id=0):
-                optimizerD.backward(gD)
-            with amp.scale_loss(errD_fake, optimizerD, loss_id=1):
-                optimizerD.backward(gDf)
-            optimizerD.step()
-
-            # (2) G, loss_id=2 (grads w.r.t. G through D)
-            s2 = live_scale(2)
-            errG, gG = g_phase(optimizerG.params, optimizerD.params,
-                               noise, s2)
-            with amp.scale_loss(errG, optimizerG, loss_id=2):
-                optimizerG.backward(gG)
-            optimizerG.step()
-
+            errD_real, errD_fake, errG, s0, s1, s2 = train_iter(it)
             it += 1
             if it == opt.warmup and it < total:
+                # Warm the print path too before starting the steady
+                # clock: the division/stack pack compiles on first use,
+                # which is SECONDS through a tunneled chip and would
+                # otherwise land inside the steady window at the first
+                # print (measured: 3.45 -> ~30 it/s steady).
+                np.asarray(jnp.stack([errD_real / s0, errD_fake / s1,
+                                      errG / s2]))
                 t_steady = time.perf_counter()     # compiles are behind us
             if (opt.print_freq > 0 and it % opt.print_freq == 0) \
                     or it == total:
@@ -181,13 +199,31 @@ def main():
                 print(f"[{epoch}/{opt.niter}][{i}/{opt.iters_per_epoch}] "
                       f"Loss_D: {packed[0] + packed[1]:.4f} "
                       f"Loss_G: {packed[2]:.4f}")
-    float(jnp.ravel(jax.tree_util.tree_leaves(
-        optimizerG.params)[-1])[0].astype(jnp.float32))   # drain pipeline
+    drain()
     t1 = time.perf_counter()
     if t_steady is not None and total > opt.warmup:
         n_steady = total - opt.warmup
         print(f"steady {n_steady / (t1 - t_steady):.2f} it/s over "
               f"{n_steady} iters (excl {opt.warmup} warmup)")
+
+    # Best-of-3 windows under the repo's min-of-reps timing policy: the
+    # single steady window above can eat a multi-second tunnel stall
+    # (the same loop measured 23 ms and 200 ms per iter in back-to-back
+    # windows; device trace shows ~2 ms/iter of actual device work), so
+    # the rate the loop DEMONSTRABLY achieves is reported beside it.
+    if total >= 8:         # skipped in tiny CPU smokes
+        k = 8
+        best = float("inf")
+        for _ in range(3):
+            drain()
+            tp_ = time.perf_counter()
+            for j in range(k):
+                train_iter(it + j)
+            drain()
+            best = min(best, (time.perf_counter() - tp_) / k)
+            it += k
+        print(f"best-of-3 windows: {1.0 / best:.2f} it/s "
+              f"({best * 1e3:.1f} ms/iter over {k}-iter windows)")
 
     # Dispatch budget (VERDICT r4 next #6): the imperative path's floor on
     # a tunneled chip is per-program fixed cost + per-leaf-arg cost; print
@@ -202,15 +238,16 @@ def main():
     n_leaves = ((n_d + n_g + 4)          # d_phase
                 + (n_g + n_d + 2)        # g_phase
                 + 4 * n_d + 4 * n_g)     # stepD + stepG
-    # Not in the floor: the three backward() unscale sweeps run EAGERLY
-    # (multi_tensor_scale is not a separate jitted program) — ~2 tiny
-    # cached ops per grad leaf, dispatched async (~free through the
-    # tunnel; measured ~0 ms for 20 such dispatches).  Counted here so
-    # the budget states what it excludes.
-    n_eager = 2 * (2 * n_d + n_g)
+    # Also dispatched per iter: 6 TINY jitted scaler programs (3 jitted
+    # unscale/axpby sweeps + 3 update_scale lanes — r5 moved these from
+    # ~100 eager per-leaf dispatches, which cost ~0.8 ms EACH through
+    # the tunnel and dominated the loop at 261 ms/iter).  Their measured
+    # contribution is small (best window ~33 ms/iter lands ON the
+    # 4-heavy-program floor), so the floor counts the heavy programs
+    # only and names what it excludes.
     floor_ms = 4 * 7.0 + n_leaves * 0.022
-    print(f"dispatch budget: 4 jitted programs/iter + ~{n_eager} eager "
-          f"unscale dispatches, ~{n_leaves} leaf-args/iter, "
+    print(f"dispatch budget: 4 heavy + 6 tiny jitted programs/iter, "
+          f"~{n_leaves} leaf-args/iter, "
           f"floor ~{floor_ms:.1f} ms/iter "
           f"({1000.0 / floor_ms:.1f} it/s tunnel-physics bound)")
     print(f"done in {t1 - t0:.1f}s ({total / (t1 - t0):.2f} it/s)")
