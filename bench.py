@@ -1104,6 +1104,8 @@ def main():
             "fused_adam_chained_ms": round(t_adam_chained * 1e3, 3),
             "imagenet_example_img_s_steady": ex.get("img_per_sec_steady"),
             "dcgan_example_it_s_steady": dc.get("it_per_sec_steady"),
+            "dcgan_example_it_s_best_window": dc.get(
+                "it_per_sec_best_window"),
             "measured_matmul_tflops": (
                 round(measured_med / 1e12, 1) if measured_med else None),
             "measured_matmul_tflops_band": (

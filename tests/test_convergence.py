@@ -107,6 +107,21 @@ def test_convergence_artifact_if_present():
 
     for path in arts:
         art = json.loads(path.read_text())
+        if "verdicts" in art:
+            # sharded-topology artifact (tools/convergence_sharded.py):
+            # different schema — every topology verdict must be green AND
+            # recompute from the shipped curves (a stale ok flag over
+            # regenerated curves must not pass).
+            assert art["ok"], (path.name, art["verdicts"])
+            for topo, v in art["verdicts"].items():
+                assert v["ok"], (path.name, topo, v)
+                curves = art[f"losses_{topo}"]
+                re0 = gate_dp(curves["O0_single"], curves["O0_sharded"],
+                              head_gate=True)
+                re2 = gate_dp(curves["O2_single"], curves["O2_sharded"],
+                              head_gate=False)
+                assert re0["ok"] and re2["ok"], (path.name, topo, re0, re2)
+            continue
         assert art["verdict"]["ok"], (path.name, art["verdict"])
         recomputed = gate(art["losses_o0"], art["losses_o2"])
         assert recomputed["ok"], (path.name, recomputed)

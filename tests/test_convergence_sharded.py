@@ -10,7 +10,10 @@ pytestmark = pytest.mark.slow
 
 def test_sharded_trajectories_track_single():
     from tools.convergence_sharded import run_gates
-    art = run_gates(steps=60, log_every=0)
+    # 100 steps: the dp x tp toy transformer needs ~80+ steps before the
+    # "learned" criterion (tail < 0.6 * head) turns green (the 120-step
+    # driver artifact reaches tail ~0.02; at 60 steps it is still ~2.1).
+    art = run_gates(steps=100, log_every=0)
     for topo, v in art["verdicts"].items():
         assert v["o0"]["ok"], (topo, v["o0"])
         assert v["o2"]["ok"], (topo, v["o2"])

@@ -392,14 +392,17 @@ def test_tp_self_attention_flash_kernel_on_chip():
     the kernel path here; the dispatch itself (sub-crossover shapes
     routing to jnp) is covered by test_flash_dispatch_* in
     tests/test_flash_attention.py."""
-    import apex_tpu.ops.flash_attention as fa
+    # NOTE: `import apex_tpu.ops.flash_attention as fa` binds the
+    # FUNCTION re-exported by ops/__init__ (it shadows the submodule
+    # attribute) — import the symbol directly instead.
+    from apex_tpu.ops.flash_attention import _KERNEL_MIN_KV
     from jax.sharding import Mesh, PartitionSpec as P
     from jax import shard_map
     from apex_tpu.ops.attention import dot_product_attention
     from apex_tpu.parallel.tensor_parallel import tp_self_attention
 
     rng = np.random.RandomState(5)
-    B, T, d, H, hd = 2, max(1024, fa._KERNEL_MIN_KV), 64, 4, 32
+    B, T, d, H, hd = 2, max(1024, _KERNEL_MIN_KV), 64, 4, 32
     x = jnp.asarray(rng.randn(B, T, d) * .5, jnp.float32)
     wqkv = jnp.asarray(rng.randn(d, 3, H, hd) * .2, jnp.float32)
     wo = jnp.asarray(rng.randn(H * hd, d) * .2, jnp.float32)
