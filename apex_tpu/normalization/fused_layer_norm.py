@@ -56,6 +56,33 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu" and pltpu is not None
 
 
+# In-context pallas-vs-jnp crossover, measured on the v5e inside the
+# jitted BERT-base O2 train step (r5, device-loop ms/step, interleaved
+# min-of-5 pairs): at LN rows x width [2048, 768] (b16 x s128) the jnp
+# path wins the WHOLE STEP by ~9% (15.8-16.0 vs 17.4-17.6 ms) — the
+# custom call is a fusion barrier, and ~50 launches/step of fixed
+# overhead cannot amortize over 1.5M elements; at [8192, 768] (s512)
+# the kernel wins by ~0.6% (72.2 vs 72.6 ms).  Same lesson as the
+# attention dispatch (ops/flash_attention.py): below the crossover the
+# XLA-fused jnp math IS the fast path.  Isolated microbenches understate
+# the jnp side (they can't see cross-op fusion), so the threshold is set
+# from the in-context pairs: dispatch to jnp under ~4M LN elements.
+_JNP_MAX_ELEMENTS = 4 * 1024 * 1024
+
+
+def _dispatch_pallas(n1: int, n2: int, impl: Optional[str]) -> bool:
+    """True when the pallas kernel should run: explicit ``impl`` wins,
+    otherwise the measured in-context crossover decides."""
+    if impl not in (None, "pallas", "jnp"):
+        raise ValueError(
+            f"impl must be None, 'pallas', or 'jnp'; got {impl!r}")
+    if not _use_pallas():
+        return False          # hard gate: no Mosaic off-TPU
+    if impl is not None:
+        return impl == "pallas"
+    return n1 * n2 >= _JNP_MAX_ELEMENTS
+
+
 def _normalize_shape(normalized_shape) -> Tuple[int, ...]:
     if isinstance(normalized_shape, numbers.Integral):
         return (int(normalized_shape),)
@@ -114,6 +141,22 @@ def _bwd_input_ref(g2d, x2d, mean, invvar, weight):
 _ROW_BLOCK = 256
 
 
+def _pick_rows(n1: int, n2: int, bytes_per_elem: int) -> int:
+    """Row-block size that keeps the kernel's VMEM footprint bounded.
+
+    ``bytes_per_elem`` is the per-[rows, n2]-element footprint of the
+    calling kernel: the backward block holds g, x, dx at the input
+    itemsize plus four fp32 row-major temporaries (3*isz + 16 — 22 B at
+    bf16), the forward x, out plus ~3 fp32 temporaries (2*isz + 12).  A
+    fixed 256-row block OOMs scoped VMEM (16 MB) once n2 reaches ~4k
+    (measured r5: [32768, 4096] bf16 bwd asked for 20.25 MB); budget
+    ~12 MB and round down to the sublane multiple.
+    """
+    budget_rows = int(12e6 // (bytes_per_elem * n2))
+    rows = min(_ROW_BLOCK, max(8, (budget_rows // 8) * 8))
+    return min(rows, n1)
+
+
 def _fwd_kernel(x_ref, w_ref, b_ref, out_ref, mean_ref, invvar_ref, *,
                 eps, affine, has_bias):
     xf = x_ref[:].astype(jnp.float32)
@@ -147,7 +190,8 @@ def _bwd_kernel(g_ref, x_ref, mean_ref, invvar_ref, w_ref, dx_ref, *, affine):
 
 def _pallas_fwd(x2d, weight, bias, eps):
     n1, n2 = x2d.shape
-    rows = min(_ROW_BLOCK, n1)
+    isz = jnp.dtype(x2d.dtype).itemsize
+    rows = _pick_rows(n1, n2, 2 * isz + 12)
     grid = (pl.cdiv(n1, rows),)
     affine = weight is not None
     has_bias = bias is not None
@@ -179,7 +223,8 @@ def _pallas_fwd(x2d, weight, bias, eps):
 
 def _pallas_bwd_input(g2d, x2d, mean, invvar, weight):
     n1, n2 = x2d.shape
-    rows = min(_ROW_BLOCK, n1)
+    isz = jnp.dtype(x2d.dtype).itemsize
+    rows = _pick_rows(n1, n2, 3 * isz + 16)
     grid = (pl.cdiv(n1, rows),)
     affine = weight is not None
     w = weight if affine else jnp.zeros((n2,), x2d.dtype)
@@ -232,19 +277,26 @@ def _layer_norm_bwd(eps, use_pallas, res, g):
 _layer_norm.defvjp(_layer_norm_fwd, _layer_norm_bwd)
 
 
-def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
+def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
+                     impl: Optional[str] = None):
     """Functional fused layer norm (reference ``fused_layer_norm.py:64-68``
-    ``fused_layer_norm``/``fused_layer_norm_affine``)."""
+    ``fused_layer_norm``/``fused_layer_norm_affine``).
+
+    ``impl``: ``None`` (default) picks pallas-vs-jnp by the measured
+    in-context crossover (see ``_JNP_MAX_ELEMENTS``); ``"pallas"`` /
+    ``"jnp"`` force a path (pallas still requires the TPU backend).
+    """
     n1, n2 = _compute_n1_n2(x.shape, normalized_shape)
     x2d = x.reshape(n1, n2)
     w = weight.reshape(n2) if weight is not None else None
     b = bias.reshape(n2) if bias is not None else None
-    out = _layer_norm(x2d, w, b, float(eps), _use_pallas())
+    out = _layer_norm(x2d, w, b, float(eps), _dispatch_pallas(n1, n2, impl))
     return out.reshape(x.shape)
 
 
-def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5):
-    return fused_layer_norm(x, normalized_shape, weight, bias, eps)
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5,
+                            impl: Optional[str] = None):
+    return fused_layer_norm(x, normalized_shape, weight, bias, eps, impl)
 
 
 # -- flax module --------------------------------------------------------------
@@ -262,6 +314,7 @@ class FusedLayerNorm(nn.Module):
     normalized_shape: Union[int, Sequence[int]] = None
     eps: float = 1e-5
     elementwise_affine: bool = True
+    impl: Optional[str] = None      # None = measured crossover dispatch
 
     @nn.compact
     def __call__(self, x):
@@ -271,4 +324,4 @@ class FusedLayerNorm(nn.Module):
             bias = self.param("bias", nn.initializers.zeros, ns, jnp.float32)
         else:
             weight = bias = None
-        return fused_layer_norm(x, ns, weight, bias, self.eps)
+        return fused_layer_norm(x, ns, weight, bias, self.eps, self.impl)

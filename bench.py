@@ -450,6 +450,44 @@ def _make_bert_step(batch=16, seq=128):
             step_fn)
 
 
+def _bert_mfu_bound(ledger, flops, measured_med, prof):
+    """Additive no-overlap reference model for the BERT step: matmuls at
+    the calibration-median rate PLUS the intrinsic Adam state sweep (30
+    B/param) at the trace's loop-fusion bandwidth, as if the two never
+    overlapped.
+
+    NOT a hard ceiling: XLA fuses part of the update into wgrad-matmul
+    epilogues and real matmuls can beat the calibration median, so a
+    measured step may land under the additive total (an r5 run did:
+    13.64 ms vs 14.18 additive).  Its value is the decomposition — how
+    much of the step the non-matmul intrinsic traffic explains — not a
+    gate.  Falls back to ~800 GB/s (v5e HBM) when the trace lacks a
+    loop-fusion row.
+    """
+    if not (ledger and measured_med) or "error" in (ledger or {}):
+        return None
+    ideal_ms = flops / measured_med * 1e3
+    opt_gb = ledger["intrinsic"].get("optimizer_gb")
+    if not opt_gb:
+        return None
+    bw = 800.0
+    for row in (prof or {}).get("by_category", []):
+        if row.get("category") == "loop fusion" and row.get("gb_per_s"):
+            bw = row["gb_per_s"]
+            break
+    floor_ms = opt_gb / bw * 1e3
+    return {
+        "ideal_matmul_ms": round(ideal_ms, 2),
+        "optimizer_sweep_ms": round(floor_ms, 2),
+        "optimizer_sweep_bw_gb_s": round(bw, 1),
+        "additive_model_mfu_pct": round(
+            100 * ideal_ms / (ideal_ms + floor_ms), 1),
+        "note": ("additive no-overlap model at the calibration median; "
+                 "a measured step can beat it (epilogue fusion, "
+                 "above-median matmuls) — reference point, not a ceiling"),
+    }
+
+
 # -- FusedAdam whole-model step vs eager per-tensor loop ----------------------
 
 def _adam_fused_vs_eager(iters):
@@ -876,14 +914,38 @@ def main():
     t_bert, bstate = _time_steps(bstep, bstate, bdata, max(iters // 2, 2))
     prof_bert, _tp_b = (_prof_top_ops(bstep, bstate, bdata)
                        if on_tpu else (None, None))
+    # Bytes ledger for BERT (r5): the mfu_vs_measured gap is bounded by
+    # the NON-matmul intrinsic traffic (Adam state sweep, embedding
+    # gathers, LN/residual streams) — same evidence the ResNet-50 ledger
+    # gives for "roofline vs schedule".
+    ledger_bert = None
+    if _tp_b is not None:
+        try:
+            from apex_tpu.prof.ledger import bytes_ledger
+            ledger_bert = bytes_ledger(
+                bstep_fn, (bstate, bdata), _tp_b,
+                steps=_PROF_TRACE_STEPS, n_params=n_params,
+                optimizer="adam")
+            ledger_bert["intrinsic"]["by_layer"] = (
+                ledger_bert["intrinsic"]["by_layer"][:10])
+        except Exception as e:           # never fail the bench on prof
+            ledger_bert = {"error": f"{type(e).__name__}: {e}"}
     t_bert_dl = (_time_steps_device_loop(bstep_fn, bstate_dl, bdata, k=16)
                  if on_tpu else t_bert)
     del bstep, bstate, bdata, bstate_dl
     bert_flops = _bert_flops_per_step(n_dense, b_batch, b_seq, hidden,
                                       vocab, 12)
     bert_implied = bert_flops / t_bert_dl
+    from apex_tpu.normalization.fused_layer_norm import _dispatch_pallas
     from apex_tpu.ops.flash_attention import _KERNEL_MIN_KV
-    bert_kernels = (["fused_layer_norm", "xentropy"]
+    # Report the kernels the step ACTUALLY dispatches to at this shape:
+    # LN routes to jnp below its in-context crossover (r5), like
+    # attention below _KERNEL_MIN_KV.  Ask the dispatch itself so the
+    # report can't drift from the rule.
+    bert_kernels = (["xentropy"]
+                    + (["fused_layer_norm"]
+                       if _dispatch_pallas(b_batch * b_seq, hidden, None)
+                       else [])
                     + (["flash_attention"] if b_seq >= _KERNEL_MIN_KV
                        else []))
 
@@ -989,6 +1051,15 @@ def main():
             # attention kernel genuinely does not run in this step.
             "pallas_kernels": (bert_kernels if on_tpu else []),
             "prof_measured": prof_bert,
+            "bytes_ledger": ledger_bert,
+            # Additive no-overlap decomposition of the step (see
+            # _bert_mfu_bound): analytic matmul FLOPs at the measured-
+            # median rate + the intrinsic Adam state sweep (30 B/param)
+            # at the trace's loop-fusion bandwidth.  Explains where the
+            # distance to 100% mfu_vs_measured physically goes; not a
+            # ceiling (the schedule overlaps part of the sweep).
+            "mfu_additive_model": _bert_mfu_bound(
+                ledger_bert, bert_flops, measured_med, prof_bert),
         },
         "flash_attention_causal": {
             "seq": fa_seq, "heads": 12, "head_dim": 64,

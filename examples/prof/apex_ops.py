@@ -49,7 +49,9 @@ def main():
     print(p.summary(top=8))
 
     # -- FusedLayerNorm: fwd + bwd -----------------------------------------
-    ln = FusedLayerNorm(normalized_shape=256)
+    # impl="pallas": this section profiles the KERNEL; [64, 256] is far
+    # below the r5 auto-dispatch crossover and would route to jnp.
+    ln = FusedLayerNorm(normalized_shape=256, impl="pallas")
     x = jnp.asarray(rng.randn(64, 256), jnp.float32)
     variables = ln.init(jax.random.PRNGKey(0), x)
 

@@ -6,6 +6,8 @@ non-affine, multiple shapes and dtypes, plus torch CPU as an independent
 oracle.
 """
 
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -107,3 +109,42 @@ def test_jit_and_grad_composability():
     out = g(x, jnp.ones((32,)), jnp.zeros((32,)))
     assert out.shape == (4, 32)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_impl_dispatch_crossover():
+    """Auto dispatch: jnp below the measured in-context crossover, the
+    pallas kernel at/above it; explicit impl= overrides; bad impl raises
+    (r5, see _JNP_MAX_ELEMENTS in fused_layer_norm.py)."""
+    import apex_tpu.normalization.fused_layer_norm  # noqa: F401
+    fln = sys.modules["apex_tpu.normalization.fused_layer_norm"]
+    orig = fln._use_pallas
+    fln._use_pallas = lambda: True       # pretend we are on chip
+    try:
+        # BERT b16 x s128: 2048 x 768 = 1.57M elements -> jnp
+        assert not fln._dispatch_pallas(2048, 768, None)
+        # BERT b16 x s512: 8192 x 768 = 6.3M elements -> pallas
+        assert fln._dispatch_pallas(8192, 768, None)
+        assert fln._dispatch_pallas(2048, 768, "pallas")
+        assert not fln._dispatch_pallas(8192, 768, "jnp")
+        with pytest.raises(ValueError):
+            fln._dispatch_pallas(8, 8, "cuda")
+    finally:
+        fln._use_pallas = orig
+    # Off-TPU the hard gate wins even for impl="pallas".
+    if jax.default_backend() != "tpu":
+        assert not fln._dispatch_pallas(8192, 768, "pallas")
+
+
+def test_pick_rows_vmem_budget():
+    """Row blocks shrink with width so kernel VMEM stays bounded
+    (r5 fix: [32768, 4096] bwd OOMed scoped VMEM at the fixed 256)."""
+    import apex_tpu.normalization.fused_layer_norm  # noqa: F401
+    fln = sys.modules["apex_tpu.normalization.fused_layer_norm"]
+    BWD_BF16, BWD_F32 = 3 * 2 + 16, 3 * 4 + 16     # bytes/elem models
+    assert fln._pick_rows(32768, 768, BWD_BF16) == 256   # narrow: full block
+    rows_4k = fln._pick_rows(32768, 4096, BWD_BF16)
+    assert rows_4k <= 136 and rows_4k % 8 == 0           # ~12MB/22B/4096
+    # fp32 inputs carry a bigger footprint -> smaller blocks (review r5)
+    assert fln._pick_rows(32768, 4096, BWD_F32) < rows_4k
+    assert fln._pick_rows(32768, 16384, BWD_F32) >= 8    # floor
+    assert fln._pick_rows(4, 768, BWD_BF16) == 4         # never exceeds n1
