@@ -70,14 +70,22 @@ def _use_pallas() -> bool:
 _JNP_MAX_ELEMENTS = 4 * 1024 * 1024
 
 
+# Widest normalized dim the kernel can block: at the 8-row sublane floor
+# and the worst-case (fp32 backward) ~28 B/element footprint, a wider n2
+# cannot fit the ~12 MB scoped-VMEM budget at ANY row count — route to
+# jnp even under impl="pallas" rather than OOM Mosaic at compile.
+_KERNEL_MAX_WIDTH = int(12e6 // (28 * 8))        # ~53k columns
+
+
 def _dispatch_pallas(n1: int, n2: int, impl: Optional[str]) -> bool:
     """True when the pallas kernel should run: explicit ``impl`` wins,
-    otherwise the measured in-context crossover decides."""
+    otherwise the measured in-context crossover decides.  Widths beyond
+    ``_KERNEL_MAX_WIDTH`` always take the jnp path (no legal block)."""
     if impl not in (None, "pallas", "jnp"):
         raise ValueError(
             f"impl must be None, 'pallas', or 'jnp'; got {impl!r}")
-    if not _use_pallas():
-        return False          # hard gate: no Mosaic off-TPU
+    if not _use_pallas() or n2 > _KERNEL_MAX_WIDTH:
+        return False          # hard gates: no Mosaic off-TPU / no block
     if impl is not None:
         return impl == "pallas"
     return n1 * n2 >= _JNP_MAX_ELEMENTS

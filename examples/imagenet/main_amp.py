@@ -237,6 +237,26 @@ def main():
         # CALLS, not 2*spc compile iterations (ADVICE r4).
         print(f"steady {steady:.1f} img/s over {n_done - warm} iters "
               f"(excl first 2 calls)")
+    # spc > 1 only: at one step per call the 2-call window is bounded by
+    # the fixed metric-fetch round-trip (~0.5 s on the tunnel), so the
+    # "best window" would measure fetch latency, not training.
+    if (args.synthetic or args.data is None) and n_done > warm and spc > 1:
+        # Best-of-3 windows (the repo's min-of-reps policy, like the
+        # DCGAN example): one steady window can eat a multi-second
+        # tunnel stall that has nothing to do with training throughput.
+        # Each window = 2 calls (2*spc steps) synced by one metric
+        # fetch, so the fixed fetch round-trip amortizes over the
+        # window; the best window is what the chip demonstrably does.
+        win_batch = batch_or_stack
+        best = float("inf")
+        for _ in range(3):
+            t0w = time.perf_counter()
+            for _ in range(2):
+                state, metrics = step(state, win_batch)
+            fetch_metrics(metrics)
+            best = min(best, time.perf_counter() - t0w)
+        print(f"best-window {args.batch_size * 2 * spc / best:.1f} img/s "
+              f"over {2 * spc}-iter windows")
     print("done")
 
 

@@ -513,3 +513,20 @@ def test_flash_sliding_window_on_chip():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    atol=0.1, rtol=0.1)
+
+
+def test_layer_norm_dispatch_structural():
+    """The r5 auto dispatch is visible in the lowering: below the
+    in-context crossover the jitted program contains NO layer-norm
+    custom call (pure XLA fusion); at/above it, exactly the kernel.
+    Lowering only — no compile, so this stays cheap on chip."""
+    from apex_tpu.normalization.fused_layer_norm import fused_layer_norm
+
+    with jax.default_device(_tpu_dev()):
+        f = jax.jit(lambda x: fused_layer_norm(x, 768))
+        small = f.lower(
+            jax.ShapeDtypeStruct((2048, 768), jnp.bfloat16)).as_text()
+        assert "tpu_custom_call" not in small
+        big = f.lower(
+            jax.ShapeDtypeStruct((8192, 768), jnp.bfloat16)).as_text()
+        assert "tpu_custom_call" in big
