@@ -91,7 +91,7 @@ def scale_loss(loss,
                 # rather than paying a second read at their step().
                 import jax
 
-                if bool(jax.device_get(flag)):
+                if bool(jax.device_get(flag)):  # jaxlint: disable=J001 -- fallback for optimizers without the deferral hook: the flag must be host-side NOW to arm the skip latch
                     for opt in opt_list:
                         if hasattr(opt, "_arm_skip_step"):
                             opt._arm_skip_step()

@@ -222,13 +222,13 @@ class LossScaler:
 
     # -- imperative / checkpoint API (reference parity) ----------------------
     def loss_scale(self):
-        return float(jax.device_get(self._state.loss_scale))
+        return float(jax.device_get(self._state.loss_scale))  # jaxlint: disable=J001 -- imperative API parity (reference scaler.py loss_scale()); jitted paths read state.loss_scale on device
 
     def update_scale_sync(self) -> bool:
         """Imperative update: ONE host sync per step, like the reference's
         ``overflow_buf.item()`` (``scaler.py:199-200``).  Returns
         ``should_skip`` for the step-skipping contract."""
-        should_skip = bool(jax.device_get(self._state.overflow)) and self.dynamic
+        should_skip = bool(jax.device_get(self._state.overflow)) and self.dynamic  # jaxlint: disable=J001 -- the documented ONE sync per imperative step (reference overflow_buf.item()); prefer update_scale_deferred to batch it
         self._state = self.update_scale(self._state)
         return should_skip
 

@@ -95,7 +95,7 @@ class FP16_Optimizer:
             return 0.0
         self._master_grads, total = clip_grad_norm(
             self._master_grads, max_norm, norm_type)
-        return float(jax.device_get(total))
+        return float(jax.device_get(total))  # jaxlint: disable=J001 -- reference API returns the norm as a Python float for LR-schedule consumers
 
     # -- step ---------------------------------------------------------------
     def step(self, closure=None):

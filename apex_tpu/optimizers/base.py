@@ -353,8 +353,8 @@ class FusedOptimizer:
         flags = [f for f, _ in self._pending_overflow_flags]
         ids = [i for _, i in self._pending_overflow_flags]
         self._pending_overflow_flags = []
-        vals = jax.device_get(jnp.stack(flags))       # ONE host round-trip
-        if bool(vals.any()):
+        vals = jax.device_get(jnp.stack(flags))       # ONE host round-trip  # jaxlint: disable=J001 -- the deferral design: every pending scaler flag batched into one stacked transfer per step
+        if bool(vals.any()):                  # host value, already fetched
             self._skip_next_step = True
             fired = [i for i, v in zip(ids, vals) if bool(v)]
             maybe_print(f"Gradient overflow.  Skipping step "

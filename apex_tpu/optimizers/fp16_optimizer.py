@@ -76,7 +76,7 @@ class FP16_Optimizer:
     def clip_master_grads(self, max_norm, norm_type=2):
         if getattr(self, "_master_grads", None) is None:
             return 0.0
-        norm = float(jax.device_get(multi_tensor_l2norm(self._master_grads)))
+        norm = float(jax.device_get(multi_tensor_l2norm(self._master_grads)))  # jaxlint: disable=J001 -- reference clip_master_grads returns a Python float norm
         if norm > max_norm and norm > 0:
             coef = max_norm / (norm + 1e-6)
             self._master_grads = jax.tree_util.tree_map(
@@ -87,8 +87,8 @@ class FP16_Optimizer:
         grads = getattr(self, "_master_grads", None)
         if grads is None:
             raise ValueError("step() before backward()/update_master_grads()")
-        norm = jax.device_get(self._compute_grad_norm(grads))
-        norm_overflow = bool(norm == -1.0)
+        norm = jax.device_get(self._compute_grad_norm(grads))  # jaxlint: disable=J001 -- legacy FP16_Optimizer contract: Python-level skip decision per step (one sync); the jitted path is make_train_step
+        norm_overflow = bool(norm == -1.0)    # host value, already fetched
         # Skip coherence (reference fp16_optimizer.py:176-194): the step is
         # gated on the scaler's recorded overflow AND the norm check, and the
         # dynamic scale update sees the combined decision — an overflow found

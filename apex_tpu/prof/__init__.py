@@ -12,6 +12,11 @@ Three stages, mapped TPU-natively (SURVEY.md §2.9, §5):
    records, MXU-eligibility column, XLA cost-model cross-check) +
    :func:`apex_tpu.prof.parse.attach_measured` joining measured time onto
    the analytic records.
+
+Plus the compile-behavior assertion :mod:`apex_tpu.prof.trace_count`
+(``assert_trace_count``) — the runtime complement to the static
+``tools/jaxlint`` J004 retracing rule: wrap it around a jitted step in a
+test to pin "one compile, zero retraces".
 """
 
 from .analysis import OpRecord, Profile, profile_function   # noqa: F401
@@ -19,3 +24,4 @@ from .capture import (init, annotate, scope, trace,          # noqa: F401
                       dump_markers, MARKERS)
 from .parse import (KernelRecord, TraceProfile, parse_trace,  # noqa: F401
                     attach_measured)
+from .trace_count import assert_trace_count, trace_count     # noqa: F401

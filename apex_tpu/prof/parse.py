@@ -31,7 +31,15 @@ import os
 import re
 from typing import Dict, List, NamedTuple, Optional
 
-__all__ = ["KernelRecord", "TraceProfile", "parse_trace", "attach_measured"]
+__all__ = ["KernelRecord", "TraceProfile", "parse_trace", "attach_measured",
+           "LOOP_FUSION_CATEGORY"]
+
+# XLA's ``hlo_category`` string for elementwise loop fusions — the
+# category the optimizer state sweep of a train step lands in.  Named
+# here (next to the parser that surfaces categories) so consumers like
+# ``bench._bert_mfu_bound`` match it by constant instead of a string
+# literal that silently drifts if the category tables ever rename it.
+LOOP_FUSION_CATEGORY = "loop fusion"
 
 
 class KernelRecord(NamedTuple):

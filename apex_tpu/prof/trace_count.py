@@ -1,0 +1,73 @@
+"""Trace-count assertions — the runtime complement to jaxlint's J004.
+
+The static analyzer (``tools/jaxlint``) can only *guess* at retracing
+hazards from the AST; the ground truth is the jit cache itself.  This
+module turns that cache into a test assertion so tier-1 pins the
+compile behavior of the hot paths: a training step should trace exactly
+once, and every subsequent call with same-shaped inputs should reuse
+the trace.  A silent retrace-per-step is the failure mode that shows up
+as a 10x dispatch-floor regression in ``bench.py`` while every
+numerical test stays green.
+
+Usage (the shape ``tests/test_prof.py`` gates on)::
+
+    step = jax.jit(step_fn)
+    with assert_trace_count(step, 1):          # first call compiles...
+        state, m = step(state, batch)
+        for _ in range(4):
+            state, m = step(state, batch)      # ...the rest must not
+
+    with assert_trace_count(step, 0):          # steady state: no retrace
+        state, m = step(state, batch)
+
+Counting is by the jitted callable's tracing-cache size (one entry per
+(shapes, dtypes, static args) signature), so it needs no profiler, no
+TPU, and works under ``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["trace_count", "assert_trace_count"]
+
+
+def trace_count(jitted) -> int:
+    """Number of distinct traces the jitted callable has performed so
+    far (its tracing-cache size).  Accepts anything ``jax.jit`` /
+    ``pjit`` returned."""
+    # PjitFunction exposes the tracing-cache size; anything without it
+    # (a plain function, a partial over a jitted callable) cannot be
+    # counted — fail loudly rather than report 0 forever.
+    size = getattr(jitted, "_cache_size", None)
+    if size is None:
+        raise TypeError(
+            f"{jitted!r} has no tracing cache — pass the object returned "
+            f"by jax.jit itself (not a wrapper around it)")
+    return size()
+
+
+@contextlib.contextmanager
+def assert_trace_count(jitted, expect: int, *, exact: bool = True):
+    """Assert that exactly (or, with ``exact=False``, at most)
+    ``expect`` NEW traces of ``jitted`` happen inside the block.
+
+    ``assert_trace_count(step, 1)`` around a warmup-plus-N-steps loop
+    pins "one compile, zero retraces"; ``assert_trace_count(step, 0)``
+    around steady-state calls pins "no retrace ever".
+    """
+    before = trace_count(jitted)
+    yield
+    got = trace_count(jitted) - before
+    name = getattr(jitted, "__name__", repr(jitted))
+    if got > expect:
+        raise AssertionError(
+            f"{name} traced {got} time(s) in this block, expected "
+            f"{'exactly' if exact else 'at most'} {expect} — a retrace "
+            f"per call usually means a Python scalar or a dtype/shape "
+            f"varies across calls (jaxlint J004)")
+    if exact and got < expect:
+        raise AssertionError(
+            f"{name} traced {got} time(s) in this block, expected exactly "
+            f"{expect} — fewer traces than expected (not invoked enough, "
+            f"or a signature was already cached before the block)")

@@ -125,7 +125,7 @@ def _calibrate_peak(iters=48, reps=3, n=8192):
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        float(run(x0, b))
+        float(run(x0, b))  # jaxlint: disable=J001 -- timing fence: the calibration pass must block until the matmul completes
         dt = (time.perf_counter() - t0) / iters
         rates.append(2 * n ** 3 / dt)
     del x0, b                              # free HBM before the workloads
@@ -470,16 +470,24 @@ def _bert_mfu_bound(ledger, flops, measured_med, prof):
     opt_gb = ledger["intrinsic"].get("optimizer_gb")
     if not opt_gb:
         return None
-    bw = 800.0
+    from apex_tpu.prof.parse import LOOP_FUSION_CATEGORY
+    bw, bw_source = 800.0, "fallback_v5e_hbm"
     for row in (prof or {}).get("by_category", []):
-        if row.get("category") == "loop fusion" and row.get("gb_per_s"):
-            bw = row["gb_per_s"]
+        if row.get("category") == LOOP_FUSION_CATEGORY \
+                and row.get("gb_per_s"):
+            bw, bw_source = row["gb_per_s"], "measured_" \
+                + LOOP_FUSION_CATEGORY.replace(" ", "_")
             break
     floor_ms = opt_gb / bw * 1e3
     return {
         "ideal_matmul_ms": round(ideal_ms, 2),
         "optimizer_sweep_ms": round(floor_ms, 2),
         "optimizer_sweep_bw_gb_s": round(bw, 1),
+        # drift guard (ADVICE r5): says whether the bandwidth above was
+        # measured from the trace's loop-fusion row or is the hardcoded
+        # 800 GB/s fallback — a renamed category can no longer silently
+        # change the additive model without signal.
+        "optimizer_sweep_bw_source": bw_source,
         "additive_model_mfu_pct": round(
             100 * ideal_ms / (ideal_ms + floor_ms), 1),
         "note": ("additive no-overlap model at the calibration median; "
@@ -962,10 +970,12 @@ def main():
     # Report the kernels the step ACTUALLY dispatches to at this shape:
     # LN routes to jnp below its in-context crossover (r5), like
     # attention below _KERNEL_MIN_KV.  Ask the dispatch itself so the
-    # report can't drift from the rule.
+    # report can't drift from the rule — including the itemsize the gate
+    # now keys on: the O2 step feeds LN bf16 activations (itemsize 2).
     bert_kernels = (["xentropy"]
                     + (["fused_layer_norm"]
-                       if _dispatch_pallas(b_batch * b_seq, hidden, None)
+                       if _dispatch_pallas(b_batch * b_seq, hidden, None,
+                                           itemsize=2)
                        else [])
                     + (["flash_attention"] if b_seq >= _KERNEL_MIN_KV
                        else []))

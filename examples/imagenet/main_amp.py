@@ -55,7 +55,11 @@ def parse():
     p.add_argument("--weight-decay", "--wd", default=1e-4, type=float)
     p.add_argument("--print-freq", "-p", default=10, type=int)
     p.add_argument("--prof", default=-1, type=int,
-                   help="stop after N iterations (profiling)")
+                   help="stop after N iterations (profiling); on "
+                        "synthetic runs with a device loop, best-window "
+                        "timing then adds 6 extra calls (3 windows x 2 "
+                        "calls, reusing one synthetic batch) beyond this "
+                        "budget")
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--sync_bn", action="store_true")
     p.add_argument("--opt-level", type=str, default="O0")

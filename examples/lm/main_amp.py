@@ -142,11 +142,18 @@ def main():
     tic = time.time()
     for i in range(args.steps):
         state, metrics = step(state, (x_tok, y_tok))
-        loss = float(jnp.ravel(metrics["loss"])[0])
+        # ONE stacked device->host transfer per step (two separate
+        # float() reads were two full pipeline-drain round-trips, the
+        # dominant per-step cost through a tunneled chip); printing
+        # every step is this demo's contract, so the remaining fetch
+        # is sanctioned.
+        packed = np.asarray(jnp.stack(      # jaxlint: disable=J001 -- per-step loss print is the demo's contract; already batched to one transfer
+            [jnp.ravel(metrics["loss"])[0], metrics["loss_scale"]]))
+        loss = packed[0]
         toc = time.time()
         tok_s = args.batch_size * (args.seq_len - 1) / max(toc - tic, 1e-9)
         print(f"step {i}  loss {loss:.4f}  "
-              f"loss_scale {float(metrics['loss_scale']):.0f}  "
+              f"loss_scale {packed[1]:.0f}  "
               f"{tok_s:,.0f} tok/s")
         tic = toc
     assert np.isfinite(loss), "training diverged"

@@ -67,6 +67,7 @@ def main():
     for t in range(500):
         state, metrics = step(state, (x, y))
         if t % 100 == 0:
+            # jaxlint: disable=J001 -- print-frequency-gated: one fetch per 100 steps, the demo's progress contract
             print(f"step {t}  loss {float(metrics['loss']):.6f}")
 
     print("final loss", float(metrics["loss"]))

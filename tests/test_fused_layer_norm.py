@@ -148,3 +148,33 @@ def test_pick_rows_vmem_budget():
     assert fln._pick_rows(32768, 4096, BWD_F32) < rows_4k
     assert fln._pick_rows(32768, 16384, BWD_F32) >= 8    # floor
     assert fln._pick_rows(4, 768, BWD_BF16) == 4         # never exceeds n1
+
+
+def test_kernel_max_width_tracks_itemsize():
+    """The max-width gate derives from the actual input itemsize
+    (ADVICE r5): the 8-row floor block must fit the VMEM budget for
+    EVERY admitted width, including dtypes wider than fp32."""
+    import apex_tpu.normalization.fused_layer_norm  # noqa: F401
+    fln = sys.modules["apex_tpu.normalization.fused_layer_norm"]
+    for isz in (2, 4, 8):                    # bf16, fp32, fp64
+        w = fln._kernel_max_width(isz)
+        floor_bytes = (3 * isz + 16) * 8 * w
+        assert floor_bytes <= fln._VMEM_BUDGET_BYTES, \
+            f"itemsize {isz}: floor block {floor_bytes / 1e6:.1f} MB " \
+            f"exceeds the budget at admitted width {w}"
+        # one column wider must be rejected (the gate is tight)
+        assert (3 * isz + 16) * 8 * (w + 1) > fln._VMEM_BUDGET_BYTES
+    # wider itemsize -> narrower gate; the old fp32 constant is the default
+    assert fln._kernel_max_width(8) < fln._kernel_max_width(4) \
+        < fln._kernel_max_width(2)
+    assert fln._KERNEL_MAX_WIDTH == fln._kernel_max_width(4)
+    # dispatch honors the per-itemsize gate: an fp64 width that passed
+    # the old fp32-tuned constant is now routed to jnp (no legal block)
+    orig = fln._use_pallas
+    fln._use_pallas = lambda: True
+    try:
+        wide = fln._kernel_max_width(8) + 8       # legal for fp32...
+        assert fln._dispatch_pallas(8, wide, "pallas", itemsize=4)
+        assert not fln._dispatch_pallas(8, wide, "pallas", itemsize=8)
+    finally:
+        fln._use_pallas = orig

@@ -1,0 +1,512 @@
+"""tools/jaxlint self-tests + the repo-wide clean-lint tier-1 gate.
+
+Two layers, mirroring the linter's contract (docs/jaxlint.md):
+
+1. fixture self-tests — for every rule J001-J006 a known-bad snippet
+   must flag and the same snippet with an inline waiver (or the real
+   fix) must pass, so a rule that silently stops firing breaks CI
+   before it stops protecting the codebase;
+2. the repo gate — ``lint_paths(apex_tpu examples tools bench.py)``
+   must return zero findings forever: introducing an unwaived host
+   sync / retrace hazard / fp32 leak fails tier-1, the same way the
+   reference relied on pjit's trace-time machinery (SNIPPETS.md [1]).
+
+Pure AST analysis: no accelerator, runs under ``JAX_PLATFORMS=cpu``
+with the standard conftest skip logic (not a ``tpu``-marked test).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.jaxlint import lint_paths, lint_source
+from tools.jaxlint.cli import main as jaxlint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_TARGETS = [os.path.join(REPO, p)
+                for p in ("apex_tpu", "examples", "tools")] \
+    + [os.path.join(REPO, "bench.py")]
+
+
+def _codes(src, path="apex_tpu/fixture.py", driver=None):
+    """Rule codes flagged for a snippet (library context by default)."""
+    return sorted({f.rule for f in
+                   lint_source(textwrap.dedent(src), path, driver=driver)})
+
+
+# -- J001: host sync in device code -------------------------------------------
+
+def test_j001_flags_host_sync_in_library_code():
+    bad = """
+    import jax
+
+    def probe(flag):
+        return float(jax.device_get(flag))
+    """
+    assert _codes(bad) == ["J001"]
+
+
+def test_j001_waiver_with_reason_passes():
+    waived = """
+    import jax
+
+    def probe(flag):
+        return float(jax.device_get(flag))  # jaxlint: disable=J001 -- test fixture
+    """
+    assert _codes(waived) == []
+
+
+def test_j001_driver_flags_only_loop_syncs():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    for i in range(10):
+        x = jnp.ones(3)
+        print(float(jax.device_get(x)))
+    done = float(jax.device_get(jnp.ones(3)))
+    """
+    findings = lint_source(textwrap.dedent(src), "examples/demo.py")
+    assert [f.rule for f in findings] == ["J001"]
+    assert "inside a loop" in findings[0].message
+
+
+def test_j001_metadata_reads_are_not_syncs():
+    ok = """
+    import jax.numpy as jnp
+
+    def widths(x):
+        y = jnp.ones(3)
+        return int(y.shape[0]), int(jnp.size(y))
+    """
+    assert _codes(ok) == []
+
+
+# -- J002: jit of non-array Python args ---------------------------------------
+
+_J002_BAD = """
+import jax
+
+def step(x, training: bool):
+    return x
+
+run = jax.jit(step)
+"""
+
+
+def test_j002_flags_unmarked_python_arg():
+    assert _codes(_J002_BAD) == ["J002"]
+
+
+def test_j002_static_argnums_passes():
+    assert _codes(_J002_BAD.replace(
+        "jax.jit(step)", "jax.jit(step, static_argnums=(1,))")) == []
+
+
+def test_j002_static_argnames_and_waiver_pass():
+    assert _codes(_J002_BAD.replace(
+        "jax.jit(step)",
+        "jax.jit(step, static_argnames=('training',))")) == []
+    assert _codes(_J002_BAD.replace(
+        "run = jax.jit(step)",
+        "run = jax.jit(step)  # jaxlint: disable=J002 -- fixture")) == []
+
+
+def test_j002_flags_str_default():
+    bad = """
+    import jax
+
+    def step(x, mode="train"):
+        return x
+
+    run = jax.jit(step)
+    """
+    assert _codes(bad) == ["J002"]
+
+
+# -- J003: fp32 leak in bf16 paths --------------------------------------------
+
+_J003_BAD = """
+import jax.numpy as jnp
+
+def forward(x, w):
+    assert str(w.dtype) == "bfloat16"
+    h = x @ w
+    wide = h.astype(jnp.float32)
+    return wide + 1
+"""
+
+
+def test_j003_flags_uncompensated_fp32_cast():
+    assert _codes(_J003_BAD) == ["J003"]
+
+
+def test_j003_compensating_downcast_passes():
+    fixed = _J003_BAD.replace("return wide + 1",
+                              "return (wide + 1).astype(x.dtype)")
+    assert _codes(fixed) == []
+
+
+def test_j003_fp32_loss_sink_is_exempt():
+    ok = """
+    import jax.numpy as jnp
+
+    def loss(x):
+        h = x.astype(jnp.bfloat16)
+        return jnp.mean(h.astype(jnp.float32))
+    """
+    # reductions/losses belong in fp32 under amp (the O1 fp32 list)
+    assert "J003" not in _codes(ok)
+
+
+def test_j003_flags_literal_promotion():
+    bad = """
+    import jax.numpy as jnp
+
+    def scale(x):
+        h = x.astype(jnp.bfloat16)
+        return h * jnp.float32(2.0)
+    """
+    assert "J003" in _codes(bad)
+
+
+# -- J004: retracing hazards --------------------------------------------------
+
+_J004_BAD = """
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda x, s: x * s)
+x = jnp.ones(3)
+for i in range(10):
+    x = step(x, i)
+"""
+
+
+def test_j004_flags_loop_scalar_into_jit():
+    assert _codes(_J004_BAD, "examples/demo.py") == ["J004"]
+
+
+def test_j004_traced_array_passes():
+    fixed = _J004_BAD.replace("step(x, i)", "step(x, jnp.asarray(i))")
+    assert _codes(fixed, "examples/demo.py") == []
+
+
+def test_j004_flags_loop_scalar_as_keyword_arg():
+    # keyword args retrace exactly like positional ones (review finding)
+    bad = _J004_BAD.replace("lambda x, s: x * s", "lambda x, s=1: x * s") \
+                   .replace("step(x, i)", "step(x, s=i)")
+    assert _codes(bad, "examples/demo.py") == ["J004"]
+
+
+def test_j004_flags_jit_inside_loop():
+    bad = """
+    import jax
+
+    def rebuild(fns, x):
+        outs = []
+        for fn in fns:
+            outs.append(jax.jit(fn)(x))
+        return outs
+    """
+    assert "J004" in _codes(bad)
+
+
+# -- J005: use-after-donate ---------------------------------------------------
+
+_J005_BAD = """
+import jax
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+def run(state, batch):
+    out = step(state, batch)
+    return state
+"""
+
+
+def test_j005_flags_read_after_donate():
+    assert _codes(_J005_BAD) == ["J005"]
+
+
+def test_j005_rebinding_passes():
+    fixed = _J005_BAD.replace("out = step(state, batch)",
+                              "state = step(state, batch)") \
+                     .replace("return state", "return state  # rebound")
+    assert _codes(fixed) == []
+
+
+def test_j005_flags_same_line_read_in_rebind():
+    # `state = f(state)` after donating state: the RHS Load evaluates
+    # before the Store even though the Store tokenizes first (review)
+    bad = """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+    def run(state, extra, batch):
+        out = step(state, batch)
+        state = jnp.concatenate([state, extra])
+        return out, state
+    """
+    assert "J005" in _codes(bad)
+
+
+def test_j005_flags_loop_without_rebind():
+    bad = """
+    import jax
+
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+    def run(state, batches):
+        for b in batches:
+            out = step(state, b)
+        return out
+    """
+    assert "J005" in _codes(bad)
+
+
+# -- J006: Python control flow on traced values -------------------------------
+
+_J006_BAD = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def clamp(x):
+    if jnp.any(x > 0):
+        return x
+    return -x
+"""
+
+
+def test_j006_flags_branch_on_traced():
+    assert _codes(_J006_BAD) == ["J006"]
+
+
+def test_j006_unjitted_branch_passes():
+    # same body outside jit: Python branching on a concrete array is fine
+    assert _codes(_J006_BAD.replace("@jax.jit\n", "")) == []
+
+
+def test_j006_where_passes():
+    fixed = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def clamp(x):
+        return jnp.where(jnp.any(x > 0), x, -x)
+    """
+    assert _codes(fixed) == []
+
+
+# -- J000: waiver hygiene -----------------------------------------------------
+
+def test_j000_waiver_without_reason_flags_and_waives_nothing():
+    bad = """
+    import jax
+
+    def probe(flag):
+        return float(jax.device_get(flag))  # jaxlint: disable=J001
+    """
+    assert _codes(bad) == ["J000", "J001"]
+
+
+def test_j000_unknown_rule_code_flags():
+    assert "J000" in _codes("x = 1  # jaxlint: disable=J999 -- nope\n")
+
+
+def test_waiver_covers_following_line():
+    # multi-line statements can't carry a trailing comment on line 1
+    src = """
+    import jax
+
+    def probe(a, b):
+        # jaxlint: disable=J001 -- fixture: stacked transfer
+        return float(jax.device_get(
+            a + b))
+    """
+    assert _codes(src) == []
+
+
+def test_file_level_waiver():
+    src = """
+    # jaxlint: disable-file=J001 -- fixture: host-side module by design
+    import jax
+
+    def probe(flag):
+        return float(jax.device_get(flag))
+    """
+    assert _codes(src) == []
+
+
+def test_trailing_waiver_does_not_bleed_to_next_line():
+    # a trailing waiver is scoped to its own line: an unrelated
+    # violation added directly below must still flag (review finding)
+    src = """
+    import jax
+
+    def probe(a, b):
+        x = float(jax.device_get(a))  # jaxlint: disable=J001 -- sanctioned
+        y = float(jax.device_get(b))
+        return x + y
+    """
+    findings = lint_source(textwrap.dedent(src), "apex_tpu/fixture.py")
+    assert [f.rule for f in findings] == ["J001"]
+    assert findings[0].line == 6          # the unwaived second sync
+
+
+def test_j001_flags_sync_on_jitted_step_outputs():
+    # tuple-unpacked results of a jitted callable are device arrays:
+    # the per-step float(metrics[...]) sync must flag (review finding —
+    # the exact bug class this PR scrubbed from examples/lm)
+    src = """
+    import jax
+
+    step = jax.jit(lambda s, b: (s, {"loss": s}))
+
+    def train(state, batches):
+        for b in batches:
+            state, metrics = step(state, b)
+            print(float(metrics["loss"]))
+        return state
+    """
+    assert "J001" in _codes(src, "examples/demo.py")
+
+
+def test_j001_metadata_mixed_with_compute_still_flags():
+    # .shape appearing INSIDE a device computation is not an exemption
+    # (review finding: float(jnp.sum(y) / y.shape[0]) is a real sync)
+    bad = """
+    import jax.numpy as jnp
+
+    def mean_of(y):
+        return float(jnp.sum(y) / y.shape[0])
+    """
+    assert _codes(bad) == ["J001"]
+    ok = """
+    import jax.numpy as jnp
+
+    def rows_times_cols(y):
+        return int(y.shape[0] * y.shape[1])
+    """
+    assert _codes(ok) == []
+
+
+def test_j001_post_fetch_host_values_are_free():
+    # the fetch is the one finding; consuming the fetched host value
+    # afterwards is plain host arithmetic (review finding)
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def drain(flags):
+        vals = jax.device_get(jnp.stack(flags))  # jaxlint: disable=J001 -- the one batched transfer
+        if bool(vals.any()):
+            return [bool(v) for v in vals]
+        return []
+    """
+    assert _codes(src) == []
+
+
+def test_j005_fires_at_module_scope():
+    # drivers donate-and-read at the top level (review finding: the
+    # fn-only read-later lookup made J005 a no-op there)
+    src = """
+    import jax
+
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+    state = init()
+    out = step(state, batch)
+    print(state)
+    """
+    assert "J005" in _codes(src, "examples/demo.py")
+
+
+def test_lambda_argument_is_not_arrayish():
+    # feeding arrays to a timing harness via a lambda must not mark the
+    # harness's host-float result arrayish (tools/attention_sweep idiom)
+    src = """
+    import jax.numpy as jnp
+
+    def sweep(timer):
+        q = jnp.ones(8)
+        t = timer(lambda: q * 2) * 1e3
+        best = bool(t < 5.0)
+        return best
+    """
+    assert _codes(src) == []
+
+
+def test_waivers_in_docstrings_are_ignored():
+    src = '''
+    def doc():
+        """Example: x  # jaxlint: disable=J001"""
+        return 1
+    '''
+    assert _codes(src) == []
+
+
+# -- CLI contract -------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert jaxlint_main([str(clean)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import jax\n\n"
+                     "def probe(f):\n"
+                     "    return float(jax.device_get(f))\n")
+    assert jaxlint_main([str(dirty)]) == 1
+    out = capsys.readouterr().out
+    assert "J001" in out and "finding" in out
+
+    assert jaxlint_main([]) == 2                       # no paths
+    assert jaxlint_main([str(tmp_path / "nope.txt")]) == 2
+    assert jaxlint_main(["--list-rules"]) == 0
+    assert "J004" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_cli_module_entry_point(tmp_path):
+    """``python -m tools.jaxlint`` — the exact invocation CI documents."""
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import jax\n\n"
+                     "def probe(f):\n"
+                     "    return float(jax.device_get(f))\n")
+    r = subprocess.run([sys.executable, "-m", "tools.jaxlint", str(dirty)],
+                       cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1 and "J001" in r.stdout
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0
+
+
+# -- the tier-1 gate ----------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """THE gate: every finding in the package, the examples, the tools,
+    and the bench is either fixed or carries a documented waiver.  A new
+    unwaived host sync / retrace hazard / fp32 leak fails tier-1 here."""
+    findings = lint_paths(LINT_TARGETS)
+    assert not findings, (
+        f"{len(findings)} jaxlint finding(s) — fix them or waive with "
+        f"'# jaxlint: disable=<rule> -- <reason>':\n"
+        + "\n".join(f.render() for f in findings))
+
+
+def test_repo_gate_actually_sees_the_package():
+    """Guard the gate itself: the walk must visit the real modules (an
+    empty file list would make the gate pass vacuously)."""
+    import glob
+    n_pkg = len(glob.glob(os.path.join(REPO, "apex_tpu", "**", "*.py"),
+                          recursive=True))
+    assert n_pkg > 30        # the package has ~40 modules

@@ -273,3 +273,75 @@ def test_analysis_cli_subprocess(tmp_path):
     assert "TOTAL" in out.stdout
     assert "marker op" in out.stdout and "dense" in out.stdout
     assert "causal=True" in out.stdout
+
+
+# -- trace-count assertions (runtime complement to jaxlint J004) --------------
+
+def test_assert_trace_count_basic():
+    f = jax.jit(lambda x: x * 2)
+    with prof.assert_trace_count(f, 1):          # first call compiles
+        for _ in range(3):
+            f(jnp.ones(3))
+    with prof.assert_trace_count(f, 0):          # steady state
+        f(jnp.ones(3))
+    assert prof.trace_count(f) == 1
+
+
+def test_assert_trace_count_catches_retrace():
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones(3))
+    with pytest.raises(AssertionError, match="J004"):
+        with prof.assert_trace_count(f, 0):
+            f(jnp.ones(5))                       # new shape: retrace
+    g = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+    with pytest.raises(AssertionError, match="J004"):
+        with prof.assert_trace_count(g, 1):
+            for i in range(3):
+                g(jnp.ones(3), i)                # static arg varies: retrace
+
+
+def test_assert_trace_count_exact_catches_missing_compile():
+    f = jax.jit(lambda x: x - 1)
+    with pytest.raises(AssertionError, match="not invoked"):
+        with prof.assert_trace_count(f, 1):
+            pass                                 # never called
+    with prof.assert_trace_count(f, 1, exact=False):
+        pass                                     # at-most mode: ok
+
+
+def test_trace_count_rejects_plain_function():
+    with pytest.raises(TypeError, match="tracing cache"):
+        prof.trace_count(lambda x: x)
+
+
+def test_amp_o2_step_compiles_once_never_retraces():
+    """The headline contract: a representative amp O2 train step traces
+    exactly once, then every same-shaped step reuses the trace.  This is
+    the runtime ground truth behind jaxlint J004 — a Python scalar or a
+    weak-type literal sneaking into the carried state would retrace
+    every step and fail here before it shows up as a 10x dispatch-floor
+    regression in bench.py."""
+    from apex_tpu import training
+    from apex_tpu.training import make_train_step
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(6, 4) * 0.3, jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    x = jnp.asarray(rng.randn(16, 6), jnp.float32)
+    y = jnp.asarray(rng.randn(16, 4) * 0.1, jnp.float32)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        out = xb @ p["w"].astype(xb.dtype) + p["b"].astype(xb.dtype)
+        return jnp.mean((out.astype(jnp.float32) - yb) ** 2)
+
+    init_fn, step_fn = make_train_step(loss_fn, training.adam(1e-2),
+                                       opt_level="O2", loss_scale="dynamic")
+    state = init_fn(params)
+    step = jax.jit(step_fn)
+    with prof.assert_trace_count(step, 1):       # one compile...
+        for _ in range(5):
+            state, metrics = step(state, (x, y))
+    with prof.assert_trace_count(step, 0):       # ...zero retraces after
+        state, metrics = step(state, (x, y))
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,962 @@
+"""jaxlint core — AST rules, waiver handling, and the lint engine.
+
+Six rules tuned to this codebase's failure modes (the ones that are
+invisible to pytest and surface as 10x dispatch-floor regressions in
+``bench.py``):
+
+* **J001** host sync in device code: ``jax.device_get`` / ``.item()`` /
+  ``.block_until_ready()`` / ``float()/int()/bool()/np.asarray()`` on
+  array values.  In library code (``apex_tpu/``) every occurrence is a
+  finding unless the enclosing function is on the host-boundary
+  allowlist (``state_dict``/``load_state_dict`` — serialization is
+  host-side by contract); in driver scripts (``examples/``, ``tools/``,
+  ``bench.py``, ``tests/``) only syncs inside loop bodies are findings
+  (a driver legitimately syncs once at the end, but a per-iteration
+  sync is the hot-loop stall the ROADMAP's dispatch floors measure).
+* **J002** ``jax.jit`` of a function taking non-array Python args
+  (bool/str-typed or bool/str-defaulted params) without covering them
+  with ``static_argnums``/``static_argnames``.
+* **J003** fp32 dtype leaks inside bf16/amp-cast paths: a function that
+  touches ``bfloat16`` and casts to ``float32`` without any
+  compensating downcast keeps the wide dtype alive downstream; also
+  ``jnp.float32(...)`` literal promotion inside arithmetic.
+* **J004** retracing hazards: a jitted callable invoked with the loop
+  induction variable (a fresh Python scalar per iteration → one
+  retrace per iteration), or ``jax.jit`` itself called inside a loop.
+* **J005** use-after-donate: a buffer passed at a ``donate_argnums``
+  position of a jitted callable and read again afterwards (donated
+  buffers are invalidated by XLA aliasing).
+* **J006** Python control flow (``if``/``while``) branching on traced
+  values inside a jitted function — trace-time concretization errors,
+  or worse, silent trace-time specialization.
+
+Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
+suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
+reason`` suppresses for the whole file.  A waiver **must** carry a
+``-- reason``; a bare waiver is itself a finding (J000) so sanctioned
+violations stay documented rather than silenced.
+
+All analysis is purely syntactic (``ast``) — no imports of the linted
+code, so the linter runs in milliseconds under ``JAX_PLATFORMS=cpu``
+with no accelerator present.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "RULES", "lint_source", "lint_file", "lint_paths"]
+
+
+RULES: Dict[str, str] = {
+    "J000": "malformed waiver (missing '-- reason' or unknown rule code)",
+    "J001": "host sync in device code (device_get/.item()/float() on arrays)",
+    "J002": "jax.jit with non-array Python args not marked static",
+    "J003": "fp32 dtype leak inside a bf16/amp-cast path",
+    "J004": "retracing hazard (jitted callable fed varying Python scalars)",
+    "J005": "use-after-donate of a donate_argnums buffer",
+    "J006": "Python control flow branching on a traced value under jit",
+}
+
+# Functions whose *contract* is the host boundary: serialization must
+# materialize host values, so J001 does not fire inside them.  Everything
+# else documents its sanctioned syncs with an inline waiver.
+_J001_HOST_BOUNDARY_FUNCS = {"state_dict", "load_state_dict"}
+
+# Path components that mark a file as a host-side driver script (J001
+# then only fires inside loop bodies).
+_DRIVER_PARTS = {"examples", "tools", "tests", "docker"}
+_DRIVER_BASENAMES = {"bench.py", "setup.py", "conftest.py"}
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# -- waivers ------------------------------------------------------------------
+
+_WAIVER_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Z][0-9]{3}(?:\s*,\s*[A-Z][0-9]{3})*)"
+    r"\s*(?:--\s*(\S.*))?")
+
+
+def _comments(src: str) -> List[Tuple[int, int, str]]:
+    """(line, col, text) of every real comment token — waiver directives
+    in docstrings or string literals (e.g. this linter's own docs) must
+    not parse as waivers."""
+    import io
+    import tokenize
+    out: List[Tuple[int, int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.start[1], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass                   # ast.parse already reported the real error
+    return out
+
+
+class _Waivers:
+    """Parsed waiver directives for one file."""
+
+    def __init__(self, src: str, path: str):
+        self.line_waivers: Dict[int, Set[str]] = {}
+        self.file_waivers: Set[str] = set()
+        self.errors: List[Finding] = []
+        lines = src.splitlines()
+        for lineno, col, text in _comments(src):
+            m = _WAIVER_RE.search(text)
+            if m is None:
+                if re.search(r"jaxlint:\s*disable", text):
+                    self.errors.append(Finding(
+                        path, lineno, col, "J000",
+                        "unparseable jaxlint directive"))
+                continue
+            kind, codes_s, reason = m.groups()
+            codes = {c.strip() for c in codes_s.split(",")}
+            bad = codes - set(RULES)
+            if bad:
+                self.errors.append(Finding(
+                    path, lineno, col, "J000",
+                    f"unknown rule code(s) {sorted(bad)} in waiver"))
+                codes -= bad
+            if not reason:
+                self.errors.append(Finding(
+                    path, lineno, col, "J000",
+                    "waiver without a '-- reason' (document why the "
+                    "violation is sanctioned)"))
+                continue        # an undocumented waiver waives nothing
+            if kind == "disable-file":
+                self.file_waivers |= codes
+                continue
+            self.line_waivers.setdefault(lineno, set()).update(codes)
+            # A comment-ONLY waiver line also covers the line below it —
+            # multi-line statements (backslash/paren continuations)
+            # cannot carry a trailing comment on their first physical
+            # line.  A trailing waiver stays scoped to its own line, so
+            # it cannot silently cover an unrelated violation added on
+            # the next line (review: the old unconditional line-1 lookup
+            # let exactly that slip through the tier-1 gate).
+            standalone = lineno <= len(lines) \
+                and not lines[lineno - 1][:col].strip()
+            if standalone:
+                self.line_waivers.setdefault(lineno + 1, set()).update(codes)
+
+    def waived(self, f: Finding) -> bool:
+        if f.rule in self.file_waivers:
+            return True
+        return f.rule in self.line_waivers.get(f.line, set())
+
+
+# -- small AST helpers --------------------------------------------------------
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); None for anything
+    not a pure dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _rooted_at(node: ast.AST, roots: Sequence[str]) -> bool:
+    d = _dotted(node)
+    if d is None:
+        return False
+    return d.split(".", 1)[0] in roots
+
+
+# Trace-time metadata: shape/dtype/aval queries are resolved during
+# tracing and never touch the device, so float()/int()/bool() of them
+# is NOT a sync even when the operand is an array.
+_STATIC_METADATA_CALLS = {
+    "jnp.size", "jnp.shape", "jnp.ndim", "jnp.result_type", "jnp.dtype",
+    "jnp.issubdtype", "np.prod", "numpy.prod", "math.prod", "len",
+    "jax.typeof", "jax.eval_shape", "jax.tree_util.tree_structure",
+}
+_STATIC_METADATA_ATTRS = {"shape", "ndim", "dtype", "itemsize", "weak_type",
+                          "vma", "aval"}
+
+
+def _is_static_metadata(node: ast.AST) -> bool:
+    """True when the expression is built ONLY from trace-time metadata
+    (shapes, dtypes, avals) — device-free by construction.  Structural,
+    not a substring scan: ``float(jnp.sum(y) / y.shape[0])`` is a real
+    device round-trip even though ``.shape`` appears inside it (review:
+    the old any-subexpression test exempted exactly that idiom)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_METADATA_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _is_static_metadata(node.value)     # x.shape[0]
+    if isinstance(node, ast.Call):
+        # metadata queries return host ints/dtypes whatever their args
+        return _dotted(node.func) in _STATIC_METADATA_CALLS
+    if isinstance(node, ast.BinOp):
+        return _is_static_metadata(node.left) \
+            and _is_static_metadata(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_metadata(node.operand)
+    if isinstance(node, ast.Compare):
+        return _is_static_metadata(node.left) \
+            and all(_is_static_metadata(c) for c in node.comparators)
+    if isinstance(node, ast.BoolOp):
+        return all(_is_static_metadata(v) for v in node.values)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_static_metadata(e) for e in node.elts)
+    return False
+
+
+def _is_arrayish(node: ast.AST, local_arrayish: Set[str]) -> bool:
+    """Heuristic: does this expression hold a (possibly traced) array?
+    True when any subexpression is rooted at jnp/jax/lax, calls
+    ``.astype``, or names a local previously bound from such a value.
+    Lambda bodies are NOT part of the expression's value (they run
+    later, with their own scope) — descending into them mistakes a
+    timing harness fed ``lambda q: flash(q)`` for an array value."""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Lambda):
+            continue
+        if isinstance(sub, ast.Name) and sub.id in local_arrayish:
+            return True
+        if isinstance(sub, ast.Call):
+            if _rooted_at(sub.func, ("jnp", "jax", "lax")):
+                return True
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr in (
+                    "astype", "block_until_ready"):
+                return True
+        if isinstance(sub, ast.Attribute) and _rooted_at(sub, ("jnp", "lax")):
+            return True
+        stack.extend(ast.iter_child_nodes(sub))
+    return False
+
+
+def _const_ints(node: ast.AST) -> Optional[Set[int]]:
+    """Literal int or tuple/list of ints -> set; None when not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for e in node.elts:
+            s = _const_ints(e)
+            if s is None:
+                return None
+            out |= s
+        return out
+    return None
+
+
+def _const_strs(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in node.elts:
+            s = _const_strs(e)
+            if s is None:
+                return None
+            out |= s
+        return out
+    return None
+
+
+class _JitSite(NamedTuple):
+    """One ``jax.jit`` application found in the module."""
+    node: ast.Call                  # the jax.jit(...) call (or decorator)
+    target: Optional[str]           # name of the function being jitted
+    bound_name: Optional[str]       # name the jitted callable is bound to
+    static_argnums: Optional[Set[int]]   # None = non-literal (unknown)
+    static_argnames: Optional[Set[str]]
+    donate_argnums: Optional[Set[int]]
+
+
+def _parse_jit_call(call: ast.Call) -> Tuple[Optional[Set[int]],
+                                             Optional[Set[str]],
+                                             Optional[Set[int]]]:
+    nums: Optional[Set[int]] = set()
+    names: Optional[Set[str]] = set()
+    donate: Optional[Set[int]] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _const_strs(kw.value)
+        elif kw.arg == "donate_argnums":
+            donate = _const_ints(kw.value)
+        elif kw.arg is None:         # **kwargs: give up on precision
+            nums = names = donate = None
+    return nums, names, donate
+
+
+def _is_jax_jit(func: ast.AST) -> bool:
+    return _dotted(func) in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+# -- module-level scan: jit sites, donated names, function defs ---------------
+
+class _ModuleIndex:
+    """Everything the per-scope rules need to know about the module.
+
+    Name bindings (``step = jax.jit(...)``) are tracked per enclosing
+    function: two unrelated locals that happen to share a name in
+    different functions must not cross-contaminate J004/J005 (``scope``
+    below is the enclosing FunctionDef node, or None at module level —
+    module-level bindings are visible from every scope)."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: Dict[str, ast.FunctionDef] = {}
+        self.jit_sites: List[_JitSite] = []
+        # (scope, name) keys; scope None = module level
+        self.jitted_names: Set[Tuple[Optional[ast.AST], str]] = set()
+        self.jitted_defs: Set[str] = set()            # def names that get traced
+        self.donated: Dict[Tuple[Optional[ast.AST], str], Set[int]] = {}
+        self._seen_calls: Set[int] = set()
+        self._scan_body(tree.body, None)
+
+    def jitted_name(self, scope, name: str) -> bool:
+        return (scope, name) in self.jitted_names \
+            or (None, name) in self.jitted_names
+
+    def donated_argnums(self, scope, name: str) -> Optional[Set[int]]:
+        got = self.donated.get((scope, name))
+        if got is None:
+            got = self.donated.get((None, name))
+        return got
+
+    def _scan_body(self, body: Sequence[ast.stmt], scope) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt, scope)
+
+    def _scan_stmt(self, stmt: ast.stmt, scope) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.defs.setdefault(stmt.name, stmt)
+            self._scan_decorators(stmt, scope)
+            self._scan_body(stmt.body, stmt)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            self._scan_body(stmt.body, scope)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                and stmt.value is not None \
+                and isinstance(stmt.value, ast.Call) \
+                and _is_jax_jit(stmt.value.func):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            bound = None
+            if len(targets) == 1 and isinstance(targets[0], ast.Name):
+                bound = targets[0].id
+            self._add_call_site(stmt.value, bound, scope)
+        # bare jax.jit(...) calls in this statement's own expressions
+        # (J002 only); skip subtrees owned by nested defs / child
+        # statements — they are visited with their own scope.
+        skip: Set[int] = set()
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, (ast.stmt, ast.excepthandler)):
+                for n in ast.walk(sub):
+                    skip.add(id(n))
+        for sub in ast.walk(stmt):
+            if sub is stmt or id(sub) in skip:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                for n in ast.walk(sub):
+                    skip.add(id(n))
+                continue
+            if isinstance(sub, ast.Call) and _is_jax_jit(sub.func) \
+                    and id(sub) not in self._seen_calls:
+                self._add_call_site(sub, None, scope)
+        # recurse into child statements (compound stmt bodies)
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.stmt):
+                self._scan_stmt(sub, scope)
+            elif isinstance(sub, ast.excepthandler):
+                self._scan_body(sub.body, scope)
+
+    def _add_call_site(self, call: ast.Call, bound: Optional[str],
+                       scope) -> None:
+        self._seen_calls.add(id(call))
+        target = None
+        if call.args:
+            a0 = call.args[0]
+            if isinstance(a0, ast.Name):
+                target = a0.id
+            elif isinstance(a0, ast.Call):
+                # jax.jit(functools.partial(fn, ...)) — resolve through
+                # the partial to the underlying def for J002/J006.
+                if _dotted(a0.func) in ("functools.partial", "partial") \
+                        and a0.args and isinstance(a0.args[0], ast.Name):
+                    target = a0.args[0].id
+        nums, names, donate = _parse_jit_call(call)
+        self.jit_sites.append(_JitSite(call, target, bound, nums, names,
+                                       donate))
+        if target:
+            self.jitted_defs.add(target)
+        if bound:
+            self.jitted_names.add((scope, bound))
+            if donate:
+                self.donated[(scope, bound)] = donate
+
+    def _scan_decorators(self, fn: ast.FunctionDef, scope) -> None:
+        for dec in fn.decorator_list:
+            site = None
+            if _is_jax_jit(dec):                       # @jax.jit
+                site = _JitSite(ast.Call(func=dec, args=[], keywords=[]),
+                                fn.name, fn.name, set(), set(), set())
+            elif isinstance(dec, ast.Call):
+                if _is_jax_jit(dec.func):              # @jax.jit(...) (rare)
+                    nums, names, donate = _parse_jit_call(dec)
+                    site = _JitSite(dec, fn.name, fn.name, nums, names,
+                                    donate)
+                elif _dotted(dec.func) in ("functools.partial", "partial") \
+                        and dec.args and _is_jax_jit(dec.args[0]):
+                    # @functools.partial(jax.jit, static_argnums=...)
+                    nums, names, donate = _parse_jit_call(dec)
+                    site = _JitSite(dec, fn.name, fn.name, nums, names,
+                                    donate)
+            if site is None:
+                continue
+            self.jit_sites.append(site)
+            self.jitted_defs.add(fn.name)
+            self.jitted_names.add((scope, fn.name))
+            if site.donate_argnums:
+                self.donated[(scope, fn.name)] = site.donate_argnums
+
+
+# -- J002: jit of non-array Python args ---------------------------------------
+
+_PYTHONISH_ANNOTATIONS = {"bool", "str"}
+
+
+def _check_j002(idx: _ModuleIndex, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for site in idx.jit_sites:
+        if site.target is None or site.target not in idx.defs:
+            continue
+        if site.static_argnums is None or site.static_argnames is None:
+            continue                      # non-literal statics: can't verify
+        fn = idx.defs[site.target]
+        args = list(fn.args.posonlyargs) + list(fn.args.args)
+        defaults = list(fn.args.defaults)
+        # align defaults with trailing positional args
+        dstart = len(args) - len(defaults)
+        for i, a in enumerate(args):
+            if a.arg in ("self", "cls"):
+                continue
+            pythonish = None
+            if isinstance(a.annotation, ast.Name) \
+                    and a.annotation.id in _PYTHONISH_ANNOTATIONS:
+                pythonish = a.annotation.id
+            d = defaults[i - dstart] if i >= dstart else None
+            if d is not None and isinstance(d, ast.Constant) \
+                    and type(d.value) in (bool, str):
+                pythonish = type(d.value).__name__
+            if pythonish is None:
+                continue
+            if i in site.static_argnums or a.arg in site.static_argnames:
+                continue
+            out.append(Finding(
+                path, site.node.func.lineno, site.node.func.col_offset,
+                "J002",
+                f"jax.jit of '{site.target}' passes Python {pythonish} "
+                f"arg '{a.arg}' (index {i}) without static_argnums/"
+                f"static_argnames — it will trace as an array (bool) or "
+                f"fail (str); mark it static"))
+    return out
+
+
+# -- J003: fp32 leaks in bf16 paths -------------------------------------------
+
+def _fn_has_bf16(fn: ast.FunctionDef) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Attribute) and sub.attr == "bfloat16":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "bfloat16":
+            return True
+    return False
+
+
+def _is_f32_dtype(node: ast.AST) -> bool:
+    d = _dotted(node)
+    if d in ("jnp.float32", "np.float32", "numpy.float32", "jax.numpy.float32"):
+        return True
+    return isinstance(node, ast.Constant) and node.value == "float32"
+
+
+# fp32 casts whose consumer keeps them fp32 *by design* are exempt:
+# softmax/log-softmax/losses/norm statistics belong in fp32 under amp
+# (the reference's O1 fp32 function list), and a cast feeding a host
+# fetch (float()/device_get) dies at the device boundary anyway.
+_J003_FP32_SINK_RE = re.compile(
+    r"softmax|loss|xent|entropy|logsumexp|norm|mean|var|sum", re.IGNORECASE)
+_J003_HOST_SINKS = {"float", "int", "bool", "print"}
+
+
+def _j003_exempt_nodes(fn: ast.FunctionDef) -> Set[int]:
+    """ids of all nodes living under an fp32-sink call."""
+    out: Set[int] = set()
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        d = _dotted(sub.func) or ""
+        attr = sub.func.attr if isinstance(sub.func, ast.Attribute) else ""
+        name = sub.func.id if isinstance(sub.func, ast.Name) else ""
+        sink = (_J003_FP32_SINK_RE.search(d or attr or name)
+                or name in _J003_HOST_SINKS
+                or d in ("jax.device_get", "np.asarray", "numpy.asarray"))
+        if sink:
+            for n in ast.walk(sub):
+                out.add(id(n))
+    return out
+
+
+def _check_j003(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        if not _fn_has_bf16(fn):
+            continue
+        exempt = _j003_exempt_nodes(fn)
+        upcasts: List[ast.Call] = []
+        has_downcast = False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call) or id(sub) in exempt:
+                continue
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr == "astype":
+                dt = sub.args[0] if sub.args else None
+                for kw in sub.keywords:
+                    if kw.arg == "dtype":
+                        dt = kw.value
+                if dt is not None and _is_f32_dtype(dt):
+                    upcasts.append(sub)
+                elif dt is not None:
+                    has_downcast = True
+            elif _dotted(sub.func) in ("jnp.asarray", "jnp.array"):
+                if not sub.args or not _is_arrayish(sub.args[0], set()):
+                    continue    # creation from host data, not a cast
+                dt = sub.args[1] if len(sub.args) > 1 else None
+                for kw in sub.keywords:
+                    if kw.arg == "dtype":
+                        dt = kw.value
+                if dt is not None and _is_f32_dtype(dt):
+                    upcasts.append(sub)
+                elif dt is not None:
+                    has_downcast = True
+        if upcasts and not has_downcast:
+            for c in upcasts:
+                out.append(Finding(
+                    path, c.lineno, c.col_offset, "J003",
+                    f"fp32 cast in bf16 function '{fn.name}' with no "
+                    f"compensating downcast anywhere in the function — "
+                    f"the widened dtype leaks to every consumer"))
+        # weak-type / literal promotion: jnp.float32(lit) inside arithmetic
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.BinOp):
+                for side in (sub.left, sub.right):
+                    if isinstance(side, ast.Call) \
+                            and _dotted(side.func) == "jnp.float32" \
+                            and side.args \
+                            and isinstance(side.args[0], ast.Constant):
+                        out.append(Finding(
+                            path, side.lineno, side.col_offset, "J003",
+                            f"jnp.float32(literal) inside arithmetic in "
+                            f"bf16 function '{fn.name}' promotes the whole "
+                            f"expression to fp32 (non-weak dtype); use a "
+                            f"plain Python literal (weak type) or cast the "
+                            f"result back"))
+    return out
+
+
+# -- per-scope walker: J001, J004, J005, J006 ---------------------------------
+
+class _ScopeWalker:
+    """Walks one scope (module body or one function body, excluding
+    nested defs which become their own scopes) tracking loop depth and
+    which locals hold arrays."""
+
+    def __init__(self, idx: _ModuleIndex, path: str, driver: bool,
+                 findings: List[Finding]):
+        self.idx = idx
+        self.path = path
+        self.driver = driver
+        self.findings = findings
+
+    def lint_module(self, tree: ast.Module) -> None:
+        self._scope(tree.body, fn=None)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scope(node.body, fn=node)
+
+    # .. scope machinery ......................................................
+
+    def _scope(self, body: List[ast.stmt], fn) -> None:
+        self.fn = fn
+        self.body = body
+        self.fn_name = fn.name if fn is not None else "<module>"
+        # Locals known to hold arrays.  Parameters are deliberately NOT
+        # assumed arrayish: ``float(eps)`` on a Python-scalar parameter is
+        # the dominant idiom and would drown real syncs in false
+        # positives; precision over recall.
+        self.arrayish: Set[str] = set()
+        self.jit_scoped = (fn is not None
+                           and fn.name in self.idx.jitted_defs)
+        self._stmts(body, loop_depth=0, loop_vars=frozenset())
+
+    def _stmts(self, body: List[ast.stmt], loop_depth: int,
+               loop_vars: frozenset) -> None:
+        for stmt in body:
+            self._stmt(stmt, loop_depth, loop_vars)
+
+    def _stmt(self, stmt: ast.stmt, loop_depth: int,
+              loop_vars: frozenset) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                      # nested defs are separate scopes
+        if isinstance(stmt, ast.Assign):
+            self._track_arrayish(stmt)
+            self._check_j005_stmt(stmt, loop_depth)
+        elif isinstance(stmt, ast.Expr):
+            self._check_j005_stmt(stmt, loop_depth)
+        # expression-level checks on this statement's own expressions
+        self._exprs(stmt, loop_depth, loop_vars)
+        # recurse into compound statements
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            new_vars = loop_vars | self._scalar_loop_vars(stmt)
+            self._stmts(stmt.body, loop_depth + 1, new_vars)
+            self._stmts(stmt.orelse, loop_depth, loop_vars)
+        elif isinstance(stmt, ast.While):
+            self._stmts(stmt.body, loop_depth + 1, loop_vars)
+            self._stmts(stmt.orelse, loop_depth, loop_vars)
+        elif isinstance(stmt, ast.If):
+            self._check_j006(stmt)
+            self._stmts(stmt.body, loop_depth, loop_vars)
+            self._stmts(stmt.orelse, loop_depth, loop_vars)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._stmts(stmt.body, loop_depth, loop_vars)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, loop_depth, loop_vars)
+            for h in stmt.handlers:
+                self._stmts(h.body, loop_depth, loop_vars)
+            self._stmts(stmt.orelse, loop_depth, loop_vars)
+            self._stmts(stmt.finalbody, loop_depth, loop_vars)
+
+    @staticmethod
+    def _scalar_loop_vars(stmt) -> frozenset:
+        """Loop targets that are definitely fresh Python scalars per
+        iteration: ``for i in range(...)`` (all targets) and the counter
+        of ``for i, x in enumerate(...)``.  Iterating arrays/leaves binds
+        traced values, which retrace nothing — only scalar counters feed
+        J004."""
+        it = stmt.iter
+        if not isinstance(it, ast.Call):
+            return frozenset()
+        d = _dotted(it.func)
+        if d == "range":
+            return frozenset(n.id for n in ast.walk(stmt.target)
+                             if isinstance(n, ast.Name))
+        if d == "enumerate" and isinstance(stmt.target, ast.Tuple) \
+                and stmt.target.elts \
+                and isinstance(stmt.target.elts[0], ast.Name):
+            return frozenset({stmt.target.elts[0].id})
+        return frozenset()
+
+    def _track_arrayish(self, stmt: ast.Assign) -> None:
+        # Results of a known-jitted callable are device arrays too —
+        # ``state, metrics = step(state, b)`` then ``float(metrics[...])``
+        # is the per-step sync this PR scrubbed from examples/lm (review:
+        # the old tracking missed both the jitted call and tuple targets).
+        v = stmt.value
+        value_arrayish = _is_arrayish(v, self.arrayish) or (
+            isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+            and self.idx.jitted_name(self.fn, v.func.id))
+        # A host fetch PRODUCES a host value: after
+        # ``vals = jax.device_get(...)`` every later bool(vals)/float()
+        # is plain host arithmetic, not another sync (review: the fetch
+        # itself is the one finding; post-fetch consumers are noise).
+        if isinstance(v, ast.Call) and (
+                _dotted(v.func) in ("jax.device_get", "np.asarray",
+                                    "numpy.asarray", "np.array",
+                                    "numpy.array")
+                or (isinstance(v.func, ast.Name)
+                    and v.func.id in ("float", "int", "bool"))):
+            value_arrayish = False
+        if len(stmt.targets) != 1:
+            return
+        target = stmt.targets[0]
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            names = [n.id for e in target.elts for n in ast.walk(e)
+                     if isinstance(n, ast.Name)]
+        else:
+            return
+        for name in names:
+            if value_arrayish:
+                self.arrayish.add(name)
+            else:
+                self.arrayish.discard(name)
+
+    def _exprs(self, stmt: ast.stmt, loop_depth: int,
+               loop_vars: frozenset) -> None:
+        # own expressions only (not nested statements/defs)
+        for expr in ast.iter_child_nodes(stmt):
+            if isinstance(expr, (ast.stmt, ast.FunctionDef)):
+                continue
+            if isinstance(expr, ast.expr):
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Call):
+                        self._check_j001_call(sub, loop_depth)
+                        self._check_j004_call(sub, loop_depth, loop_vars)
+        # While tests live on the stmt itself
+        if isinstance(stmt, ast.While):
+            self._check_j006(stmt)
+
+    # .. J001 .................................................................
+
+    def _check_j001_call(self, call: ast.Call, loop_depth: int) -> None:
+        sync: Optional[str] = None
+        d = _dotted(call.func)
+        if d in ("jax.device_get", "jax.block_until_ready"):
+            sync = d
+        elif isinstance(call.func, ast.Attribute) and call.func.attr in (
+                "item", "block_until_ready") and not call.args:
+            sync = f".{call.func.attr}()"
+        elif isinstance(call.func, ast.Name) \
+                and call.func.id in ("float", "int", "bool") \
+                and len(call.args) == 1 \
+                and _is_arrayish(call.args[0], self.arrayish) \
+                and not _is_static_metadata(call.args[0]):
+            sync = f"{call.func.id}()"
+        elif d in ("np.asarray", "numpy.asarray", "np.array", "numpy.array") \
+                and call.args and _is_arrayish(call.args[0], self.arrayish) \
+                and not _is_static_metadata(call.args[0]):
+            sync = d
+        if sync is None:
+            return
+        if self.fn_name in _J001_HOST_BOUNDARY_FUNCS:
+            return
+        if self.driver and loop_depth == 0:
+            return
+        where = ("inside a loop" if loop_depth else
+                 f"in library function '{self.fn_name}'")
+        self.findings.append(Finding(
+            self.path, call.lineno, call.col_offset, "J001",
+            f"host sync {sync} {where} — blocks dispatch until the device "
+            f"round-trip completes; keep the value on device or waive with "
+            f"a reason"))
+
+    # .. J004 .................................................................
+
+    def _check_j004_call(self, call: ast.Call, loop_depth: int,
+                         loop_vars: frozenset) -> None:
+        if loop_depth == 0:
+            return
+        if _is_jax_jit(call.func):
+            self.findings.append(Finding(
+                self.path, call.lineno, call.col_offset, "J004",
+                "jax.jit called inside a loop — a fresh jitted callable "
+                "per iteration retraces (and re-compiles) every time; "
+                "hoist the jit out of the loop"))
+            return
+        if not (isinstance(call.func, ast.Name)
+                and self.idx.jitted_name(self.fn, call.func.id)):
+            return
+        # keyword args retrace exactly like positional ones (review:
+        # ``step(x, s=i)`` was invisible to the positional-only scan)
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in loop_vars:
+                bad = arg.id
+            elif isinstance(arg, (ast.BinOp, ast.UnaryOp)) \
+                    and not any(isinstance(s, (ast.Call, ast.Subscript))
+                                for s in ast.walk(arg)) \
+                    and any(isinstance(s, ast.Name) and s.id in loop_vars
+                            for s in ast.walk(arg)):
+                bad = ast.unparse(arg)
+            else:
+                continue
+            self.findings.append(Finding(
+                self.path, call.lineno, call.col_offset, "J004",
+                f"jitted '{call.func.id}' called with loop-varying Python "
+                f"scalar '{bad}' — every new value retraces; pass it as a "
+                f"traced array (jnp.asarray) or mark it static if it takes "
+                f"few values"))
+
+    # .. J005 .................................................................
+
+    def _check_j005_stmt(self, stmt: ast.stmt, loop_depth: int) -> None:
+        if isinstance(stmt, ast.Assign):
+            call = stmt.value if isinstance(stmt.value, ast.Call) else None
+            targets: Set[str] = set()
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        targets.add(n.id)
+        elif isinstance(stmt, ast.Expr):
+            call = stmt.value if isinstance(stmt.value, ast.Call) else None
+            targets = set()
+        else:
+            return
+        if call is None or not isinstance(call.func, ast.Name):
+            return
+        donate = self.idx.donated_argnums(self.fn, call.func.id)
+        if not donate:
+            return
+        for i in donate:
+            if i >= len(call.args) or not isinstance(call.args[i], ast.Name):
+                continue
+            name = call.args[i].id
+            if name in targets:
+                continue                      # rebound by this statement: ok
+            if loop_depth > 0:
+                self.findings.append(Finding(
+                    self.path, call.lineno, call.col_offset, "J005",
+                    f"'{name}' is donated to '{call.func.id}' "
+                    f"(donate_argnums={i}) inside a loop without being "
+                    f"rebound — the next iteration re-donates a "
+                    f"deleted buffer"))
+                continue
+            if self._read_later(name, call.lineno):
+                self.findings.append(Finding(
+                    self.path, call.lineno, call.col_offset, "J005",
+                    f"'{name}' is donated to '{call.func.id}' "
+                    f"(donate_argnums={i}) but read again later in "
+                    f"'{self.fn_name}' — donated buffers are invalidated"))
+
+    def _read_later(self, name: str, after_line: int) -> bool:
+        # self.body covers module scope too — drivers donate-and-read at
+        # the top level under no function at all (review: the old
+        # fn-only lookup made J005 a no-op exactly there).
+        occurrences: List[Tuple[int, int, bool]] = []
+        for stmt in self.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and sub.id == name \
+                        and sub.lineno > after_line:
+                    occurrences.append((sub.lineno, sub.col_offset,
+                                        isinstance(sub.ctx, ast.Load)))
+        if not occurrences:
+            return False
+        occurrences.sort()
+        # ANY Load on the earliest later line is a read: in
+        # ``state = f(state)`` the RHS Load evaluates before the Store
+        # even though the Store tokenizes first (review: sorting by
+        # column let the col-0 Store mask the same-line read).
+        first_line = occurrences[0][0]
+        return any(is_load for line, _c, is_load in occurrences
+                   if line == first_line)
+
+    # .. J006 .................................................................
+
+    def _check_j006(self, stmt) -> None:
+        if not self.jit_scoped:
+            return
+        test = stmt.test
+        traced = None
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                if _rooted_at(sub.func, ("jnp", "lax")):
+                    traced = ast.unparse(sub.func)
+                    break
+                if isinstance(sub.func, ast.Attribute) and sub.func.attr in (
+                        "any", "all", "item"):
+                    traced = f".{sub.func.attr}()"
+                    break
+        if traced is None:
+            return
+        kw = "while" if isinstance(stmt, ast.While) else "if"
+        self.findings.append(Finding(
+            self.path, stmt.lineno, stmt.col_offset, "J006",
+            f"Python '{kw}' branches on traced value ({traced}) inside "
+            f"jitted '{self.fn_name}' — use jnp.where/lax.cond; Python "
+            f"control flow executes at trace time, not per step"))
+
+
+# -- engine -------------------------------------------------------------------
+
+def _is_driver_path(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return bool(set(parts) & _DRIVER_PARTS) \
+        or os.path.basename(path) in _DRIVER_BASENAMES
+
+
+def lint_source(src: str, path: str = "<string>",
+                driver: Optional[bool] = None) -> List[Finding]:
+    """Lint one source string; returns unwaived findings (plus J000 for
+    malformed waivers).  ``driver`` overrides path-based classification."""
+    if driver is None:
+        driver = _is_driver_path(path)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, e.offset or 0, "J000",
+                        f"syntax error: {e.msg}")]
+    waivers = _Waivers(src, path)
+    findings: List[Finding] = []
+    idx = _ModuleIndex(tree)
+    findings += _check_j002(idx, path)
+    findings += _check_j003(tree, path)
+    _ScopeWalker(idx, path, driver, findings).lint_module(tree)
+    kept = [f for f in findings if not waivers.waived(f)]
+    kept += waivers.errors
+    # Dedup: nested defs are walked by their enclosing function too
+    # (J003), and one expression can contain several sync calls
+    # (``float(jax.device_get(x))``) — since waivers are line-scoped,
+    # one J001 report per line is enough.
+    seen: Set[tuple] = set()
+    unique = []
+    for f in sorted(kept, key=lambda f: (f.line, f.col, f.rule)):
+        k = (f.line, f.rule) if f.rule == "J001" else (f.line, f.col, f.rule)
+        if k in seen:
+            continue
+        seen.add(k)
+        unique.append(f)
+    return unique
+
+
+def lint_file(path: str, driver: Optional[bool] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path, driver=driver)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "csrc", "node_modules",
+              ".claude"}
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    """Lint files and directory trees; returns all findings sorted by
+    (path, line)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                files += [os.path.join(dirpath, f) for f in sorted(filenames)
+                          if f.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"not a directory or .py file: {p!r}")
+    out: List[Finding] = []
+    for f in files:
+        out += lint_file(f)
+    out.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return out
