@@ -28,7 +28,8 @@ from . import multi_tensor   # noqa: F401
 import importlib as _importlib
 
 _LAZY = ("optimizers", "normalization", "parallel", "bf16_utils", "fp16_utils",
-         "RNN", "reparameterization", "contrib", "prof", "training", "models")
+         "RNN", "reparameterization", "contrib", "prof", "training", "models",
+         "runtime", "data")
 
 
 def __getattr__(name):

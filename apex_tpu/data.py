@@ -36,7 +36,14 @@ def normalize_images(u8_batch: np.ndarray,
 
 class PrefetchLoader:
     """Wrap any iterable of host batches with N-deep device prefetch
-    (the ``data_prefetcher`` analog)."""
+    (the ``data_prefetcher`` analog).
+
+    Shutdown contract: abandoning iteration (``break``, dropping the
+    iterator) trips the stop event in the generator's ``finally`` —
+    the producer thread exits and the queued device batches are
+    dropped.  :meth:`close` does the same explicitly (and joins the
+    threads) for deterministic teardown; the loader is also a context
+    manager."""
 
     def __init__(self, it, depth: int = 2,
                  transform: Optional[Callable] = None,
@@ -45,6 +52,43 @@ class PrefetchLoader:
         self._depth = depth
         self._transform = transform
         self._device = device
+        self._live: list = []  # (stop Event, Thread, Queue, sentinel)
+
+    def close(self) -> None:
+        """Release every producer this loader started: set the stop
+        events, drain the queues (dropping any staged device batches so
+        their HBM frees), and join the threads."""
+        live, self._live = self._live, []
+        for stop, t, q, sentinel in live:
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
+            # A put that was already in flight when the drain above ran
+            # can land between drain and thread exit — sweep once more
+            # now the producer is provably done.
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            # The producer's own end-of-stream put is suppressed once
+            # stop is set, so re-arm the sentinel: a consumer blocked in
+            # (or returning to) ``q.get()`` sees StopIteration instead
+            # of hanging on an empty queue with a dead producer.
+            try:
+                q.put_nowait(sentinel)
+            except queue.Full:
+                pass
+
+    def __enter__(self) -> "PrefetchLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __iter__(self) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self._depth)
@@ -79,8 +123,11 @@ class PrefetchLoader:
             finally:
                 _put(_SENTINEL)
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(target=produce, daemon=True,
+                             name="apex-tpu-prefetch")
         t.start()
+        handle = (stop, t, q, _SENTINEL)
+        self._live.append(handle)
         try:
             while True:
                 item = q.get()
@@ -98,6 +145,8 @@ class PrefetchLoader:
                     q.get_nowait()
                 except queue.Empty:
                     break
+            if handle in self._live:
+                self._live.remove(handle)
 
 
 def directory_imagenet(root: str, batch_size: int, image_size: int = 224,

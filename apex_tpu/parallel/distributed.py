@@ -36,6 +36,40 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _axis_size(axis_name) -> int:
+    """``lax.axis_size`` with a fallback for jaxlibs that predate it:
+    ``psum(1, axis)`` of a Python int constant-folds to the axis size at
+    trace time (no collective is emitted)."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:
+        return lax.psum(1, axis_name)
+
+
+def import_shard_map():
+    """Version-portable ``shard_map``: the top-level export on jax >= 0.6,
+    else a compat wrapper over the experimental home that accepts (and
+    drops) the new ``check_vma`` kwarg and pins ``check_rep=False`` —
+    the legacy rep checker mis-infers scan-carry replication under
+    K-step device loops; the vma tracking that replaced it copes."""
+    try:                                # jax >= 0.6
+        from jax import shard_map
+        return shard_map
+    except ImportError:                 # older jax: experimental home
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        def _compat(f=None, **kw):
+            kw.pop("check_vma", None)
+            kw["check_rep"] = False
+            if f is None:               # decorator form: shard_map(mesh=...)
+                return functools.partial(_compat, **kw)
+            return _legacy(f, **kw)
+
+        return _compat
+
+
 def _is_float(x):
     return hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype,
                                                   jnp.floating)
@@ -106,7 +140,7 @@ def _group_psum_butterfly(x, axis_name: str, groups, k: int):
 
 
 def _group_psum_gather_mask(x, axis_name: str, groups):
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     import numpy as _np
     from ..amp._amp_state import maybe_print
     # O(world x |tensor|) on the wire — fine for a handful of hosts,
@@ -157,7 +191,7 @@ def reduce_gradients(grads,
         raise ValueError("axis_index_groups requires a single axis name")
     full_world = 1
     for a in axis_names:
-        full_world *= lax.axis_size(a)
+        full_world *= _axis_size(a)
     explicit_world = world_size is not None
     if world_size is None:
         world_size = full_world

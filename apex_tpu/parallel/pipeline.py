@@ -42,9 +42,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .distributed import _axis_size
+
 
 def _rotate(x, axis_name: str):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -67,7 +69,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, *,
 
     Returns ``[batch, ...]`` outputs, replicated over the pp axis.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     params_i = jax.tree_util.tree_map(
         lambda p: jnp.squeeze(p, axis=0) if p.shape[0] == 1 else p,
@@ -88,7 +90,10 @@ def spmd_pipeline(stage_fn: Callable, stage_params, x, *,
         try:
             return lax.pcast(v, (axis_name,), to="varying")
         except (AttributeError, TypeError):  # older jax spelling
-            return lax.pvary(v, (axis_name,))
+            try:
+                return lax.pvary(v, (axis_name,))
+            except AttributeError:   # pre-vma jax: nothing to mark
+                return v
     buf0 = _pvary(jnp.zeros_like(micro[0]))
     out0 = _pvary(jnp.zeros_like(micro))
 
@@ -158,7 +163,7 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
     rank executes exactly one microbatch-chunk per tick — no collisions,
     ``m*v + p - 1`` ticks total, activations rotating one hop per tick.
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     r = lax.axis_index(axis_name)
     leaves = jax.tree_util.tree_leaves(stage_params)
     v = int(leaves[0].shape[0])
@@ -201,7 +206,10 @@ def spmd_pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
         try:
             return lax.pcast(val, (axis_name,), to="varying")
         except (AttributeError, TypeError):
-            return lax.pvary(val, (axis_name,))
+            try:
+                return lax.pvary(val, (axis_name,))
+            except AttributeError:   # pre-vma jax: nothing to mark
+                return val
 
     buf0 = _pvary(jnp.zeros_like(micro[0]))
     out0 = _pvary(jnp.zeros_like(micro))

@@ -34,6 +34,7 @@ from jax import lax
 
 from ..ops.attention import (attention_block_update, _init_carry,
                              finalize_attention, blockwise_attention)
+from .distributed import _axis_size
 
 
 def ring_attention(q, k, v, axis_name: str, *,
@@ -46,7 +47,7 @@ def ring_attention(q, k, v, axis_name: str, *,
     is split contiguously over ``axis_name`` in rank order.  Returns the
     local output shard [B, T/n, H, D].
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     if sm_scale is None:
@@ -95,12 +96,10 @@ def ring_attention(q, k, v, axis_name: str, *,
     # The zeros carry is axis-unvarying but the body produces values varying
     # over every manual axis q varies over (sp, plus e.g. data on a 2-D
     # mesh); align the vma types up front (shard_map scan requirement).
-    try:
+    if _vma_tracking_live(axis_name):
         target_vma = tuple(jax.typeof(q).vma | {axis_name})
-    except AttributeError:          # vma tracking off / pmap trace
-        target_vma = (axis_name,)
-    m0, l0, acc0 = jax.tree_util.tree_map(
-        lambda x: lax.pcast(x, target_vma, to="varying"), (m0, l0, acc0))
+        m0, l0, acc0 = jax.tree_util.tree_map(
+            lambda x: lax.pcast(x, target_vma, to="varying"), (m0, l0, acc0))
     (_, m, l, acc), _ = lax.scan(step, ((k, v), m0, l0, acc0),
                                  jnp.arange(n))
     return finalize_attention(m, l, acc, q.dtype)
@@ -120,7 +119,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_q,
     merged across ring steps by logsumexp weights.  Head-major in/out."""
     from ..ops.flash_attention import _flash_fwd_pallas
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, t_local, d = q.shape
     q_off = idx * t_local
@@ -159,7 +158,7 @@ def _ring_flash_bwd_impl(q, k, v, out, lse, do, axis_name, causal, sm_scale,
     that rotate WITH their kv shard and arrive home after the full cycle."""
     from ..ops.flash_attention import _flash_bwd_pallas
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, t_local, d = q.shape
     q_off = idx * t_local
@@ -273,7 +272,7 @@ def ulysses_attention(q, k, v, axis_name: str, *,
     blockwise attention over the FULL sequence → all_to_all back.
     Requires ``H % n == 0``.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"num_heads {h} not divisible by axis size {n}")

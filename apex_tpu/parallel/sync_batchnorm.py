@@ -157,3 +157,28 @@ class SyncBatchNorm(nn.Module):
         if self.fuse_relu:
             out = jax.nn.relu(out)
         return out.astype(x.dtype)
+
+
+def adopt_batchnorm_stats(batch_stats):
+    """Rename plain flax ``BatchNorm`` running stats (``mean``/``var``)
+    to :class:`SyncBatchNorm`'s reference names
+    (``running_mean``/``running_var``), recursively, leaving everything
+    else untouched.
+
+    The standard init recipe uses plain ``BatchNorm`` (SyncBatchNorm's
+    collectives need a bound mesh axis, absent at init) and swaps in the
+    sync module for training.  Without the rename the first sync apply
+    would CREATE its differently-named stats, growing the
+    ``batch_stats`` pytree mid-training — a silent retrace on the
+    jitted-per-step path and a hard error for scan-carried state
+    (:class:`apex_tpu.runtime.StepPipeline` requires structure-stable
+    carries).  Values are preserved (both modules init zeros/ones).
+    """
+    def _rename(d):
+        if isinstance(d, dict):
+            if set(d) == {"mean", "var"}:
+                return {"running_mean": d["mean"],
+                        "running_var": d["var"]}
+            return {k: _rename(v) for k, v in d.items()}
+        return d
+    return _rename(batch_stats)

@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .distributed import _axis_size
+
 try:        # jax>=0.8: Varying->Invariant gather for the vma type system;
     from jax._src.lax.parallel import (     # not yet re-exported publicly
         all_gather_invariant as _all_gather_invariant)
@@ -111,7 +113,7 @@ def zero1(tx, axis_name: str, *, num_shards: int):
         return Zero1State(inner=tx.init(flat))
 
     def update(grads, state, params, *, apply_mask=None, **kw):
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         flat_p = _flatten(params)
         flat_g = _flatten(grads).astype(flat_p.dtype)

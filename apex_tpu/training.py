@@ -148,9 +148,19 @@ def chain_steps(step_fn: Callable) -> Callable:
     TPU frameworks).  The jitted-per-step path stays the right choice
     when the host must see metrics every step (e.g. imperative loops).
 
+    Donate BOTH the carried state and the consumed window: the stacked
+    batch buffer is K full batches of HBM (2.4 GB at K=32, b128, 224px)
+    and without donation it stays pinned for the whole call — donating
+    it lets XLA release/reuse that memory while the loop still runs, so
+    the next staged window's H2D never doubles peak footprint.  A
+    donated window is consumed: build a FRESH stack per call (a reused
+    pool must not donate).  :class:`apex_tpu.runtime.StepPipeline` wraps
+    this pattern — windows staged through the prefetcher, ragged tails,
+    deferred metric reads — for the user-facing training path.
+
     Usage::
 
-        chained = jax.jit(chain_steps(step_fn), donate_argnums=(0,))
+        chained = jax.jit(chain_steps(step_fn), donate_argnums=(0, 1))
         batches = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *pool)            # pool -> [K, ...]
         state, metrics = chained(state, batches)         # K real steps

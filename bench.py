@@ -143,6 +143,13 @@ _GATE_TOL = 1.25
 # next round's regression guard only compares like-for-like (ADVICE r4).
 _TIMING_POLICY = "min_of_3_passes"
 
+# Example steady-vs-best-window gap (ISSUE 2): target the examples must
+# hold on chip, and the looser self-validation gate that fails the bench
+# loudly (tunnel noise swings single windows ~±18% pass to pass; the
+# regression class this catches is 10x, not 1.2x).
+_WINDOW_GAP_TARGET_PCT = 10.0
+_WINDOW_GAP_GATE_PCT = 25.0
+
 
 def _gate_implied(name, implied, peak, measured_max):
     if implied >= peak:
@@ -209,21 +216,58 @@ def _time_steps_device_loop(step_fn, state, batch, k=32, calls=2, reps=3):
     free of the tunnel's per-call dispatch overhead (~7 ms + ~22 us/arg
     measured here — a 9-11 ms/step tax the jitted-per-step numbers pay).
     The batch pool is the same batch broadcast K times; every step still
-    runs the full train-step math on its own carry."""
+    runs the full train-step math on its own carry.
+
+    ``donate_argnums=(0, 1)``: the loop donates BOTH the carried state
+    and the consumed window (ISSUE 2 satellite — the [K, ...] stack is K
+    full batches of HBM, ~2.4 GB at k=32/b128/224px, and un-donated it
+    stays pinned for the whole call).  A donated window is consumed, so
+    each call re-stages it with a tiny jitted broadcast program — the
+    device-side analog of the runtime's fresh staged windows (an HBM
+    write at memory bandwidth, ~3 ms for 2.4 GB, amortized over K
+    steps)."""
     from apex_tpu.training import chain_steps
 
-    chained = jax.jit(chain_steps(step_fn), donate_argnums=(0,))
-    batches = jax.tree_util.tree_map(
-        lambda a: jnp.broadcast_to(a[None], (k,) + a.shape), batch)
+    chained = jax.jit(chain_steps(step_fn), donate_argnums=(0, 1))
+    stage = jax.jit(lambda b: jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (k,) + a.shape), b))
     for _ in range(2):                     # compile + resharding warmup
-        state, m = chained(state, batches)
+        state, m = chained(state, stage(batch))
     _force((m["loss"], state))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(calls):
-            state, m = chained(state, batches)
+            state, m = chained(state, stage(batch))
         _force((m["loss"], state))
+        best = min(best, (time.perf_counter() - t0) / (calls * k))
+    return best
+
+
+def _time_steps_pipeline(step_fn, state, batch, k=32, calls=2, reps=3):
+    """Wall seconds/step of the USER-FACING training path
+    (:class:`apex_tpu.runtime.StepPipeline`): K steps per host dispatch
+    through the runtime engine itself — its Python overhead, window
+    dispatch, and the deferred (one-dispatch-behind) metric read all
+    included.  This is the number the ISSUE-2 acceptance compares
+    against ``ms_per_step_o2_device_loop``: the dispatch gap the
+    step-pipelining runtime closes for a real training loop.  The reused
+    synthetic window is NOT donated (the examples' synthetic-pool
+    shape); each rep is fenced by one stacked metric fetch."""
+    from apex_tpu import runtime as rt
+
+    pipe = rt.StepPipeline(step_fn, k, donate_window=False)
+    window = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (k,) + a.shape), batch)
+    for _ in range(2):                     # compile + resharding warmup
+        state, m = pipe.step_window(state, window)
+    _force((m["loss"], state))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state, m = pipe.step_window(state, window)
+        _force((m["loss"], state))   # drain: metrics AND final state
         best = min(best, (time.perf_counter() - t0) / (calls * k))
     return best
 
@@ -745,6 +789,16 @@ def _run_example(rel_path, argv, timeout=2400):
     return r.stdout, wall
 
 
+def _window_gap_pct(steady, best_window):
+    """Steady-vs-best-window gap, percent of the best window: how much
+    of the rate the chip DEMONSTRABLY reached the example's steady loop
+    leaves on the table (ISSUE 2: DCGAN's 12x gap hid behind the steady
+    number alone).  0 when steady meets or beats the best window."""
+    if not steady or not best_window:
+        return None
+    return round(max(0.0, 100.0 * (1.0 - steady / best_window)), 1)
+
+
 def _bench_examples(on_tpu):
     """Execute the flagship example entry points and distill their own
     printed metrics.  Gates: the run completed, every printed loss is
@@ -795,19 +849,30 @@ def _bench_examples(on_tpu):
         # multi-second tunnel stalls a single steady window can eat.
         "img_per_sec_best_window": (float(bestwin.group(1))
                                     if bestwin else None),
+        # steady-vs-best-window gap, regression-gated in main() next to
+        # the MFU sanity check (ISSUE 2 acceptance: <= 10% on chip).
+        "window_gap_pct": _window_gap_pct(
+            float(steady.group(1)) if steady else None,
+            float(bestwin.group(1)) if bestwin else None),
         "wall_s": round(wall, 1),
     }
 
-    # examples/dcgan — the imperative amp surface (amp.initialize with
-    # num_losses=3, scale_loss(loss_id=0/1/2), FusedAdam.step): the true
-    # BASELINE config 5, timed through the real example (VERDICT r2 next
-    # #6).  Three separate jitted grad fns + python-side scaler state per
-    # step, vs. the fused single-program step benched above.
-    args = (["--niter", "1", "--iters-per-epoch", "16", "--opt_level", "O1",
-             "--print-freq", "4"]
+    # examples/dcgan — the three-scaler multi-loss path (BASELINE config
+    # 5), now step-pipelined by default (ISSUE 2): the whole iteration —
+    # both D backwards, the G phase, and all three dynamic loss-scale
+    # machines — is ONE program, chained --steps-per-call iterations per
+    # dispatch through runtime.StepPipeline.  The reference-parity
+    # imperative surface (amp.initialize num_losses=3 + scale_loss
+    # loss_id + FusedAdam.step) remains under --imperative; r05 measured
+    # it at 4.67 it/s steady vs 57 best-window — 10 dispatches/iter of
+    # pure tunnel tax, which is the gap the pipelined default closes.
+    # 64 iters = 8 calls of 8: the steady clock starts after the 2
+    # compile calls and covers 48 iters; print-freq 16 = every 2nd call.
+    args = (["--niter", "1", "--iters-per-epoch", "64", "--opt_level", "O1",
+             "--print-freq", "16", "--steps-per-call", "8"]
             if on_tpu else
             ["--niter", "1", "--iters-per-epoch", "3", "--batchSize", "4",
-             "--opt_level", "O1", "--warmup", "1"])
+             "--opt_level", "O1", "--steps-per-call", "2"])
     stdout, wall = _run_example("examples/dcgan/main_amp.py", args)
     pairs = [(float(d), float(g)) for d, g in _DCGAN_RE.findall(stdout)]
     done = _DONE_RE.search(stdout)
@@ -820,28 +885,36 @@ def _bench_examples(on_tpu):
     if not all(np.isfinite(flat)):
         raise SystemExit(f"BENCH EXAMPLE FAILED: dcgan non-finite losses")
     best = _DCGAN_BEST_RE.search(stdout)
-    out["dcgan_main_amp_imperative_3scaler"] = {
+    # Renamed from dcgan_main_amp_imperative_3scaler: the three-scaler
+    # example now runs step-pipelined by default; "mode" records which
+    # path produced the numbers.
+    out["dcgan_main_amp_3scaler"] = {
         "argv": " ".join(args),
+        "mode": ("imperative" if "--imperative" in args else "pipelined"),
         "it_per_sec_incl_compile": float(done.group(2)),
-        # min-of-reps policy applied to the imperative loop: the rate the
-        # loop demonstrably achieves (single windows eat tunnel stalls;
+        # min-of-reps policy applied to the loop: the rate it
+        # demonstrably achieves (single windows eat tunnel stalls;
         # device work is ~2 ms/iter)
         "it_per_sec_best_window": (float(best.group(1)) if best else None),
         # compile-excluded rate the example prints itself (VERDICT r3
-        # next #6); still pays the imperative path's 3 scaler host-syncs
-        # per iteration — the fused joint step is benched separately in
-        # dcgan_fused_joint_step_o2.
+        # next #6); the fused single-program joint-loss step is benched
+        # separately in dcgan_fused_joint_step_o2.
         "it_per_sec_steady": float(steady.group(1)) if steady else None,
+        # steady-vs-best-window gap (ISSUE 2: this example's 12x gap hid
+        # behind the steady number) — regression-gated in main().
+        "window_gap_pct": _window_gap_pct(
+            float(steady.group(1)) if steady else None,
+            float(best.group(1)) if best else None),
         "last_loss_d": pairs[-1][0], "last_loss_g": pairs[-1][1],
         "wall_s": round(wall, 1),
     }
     # Dispatch-budget floor the example computes for itself (VERDICT r4
-    # next #6): programs/iter x ~7 ms + leaves x ~22 us — the
-    # tunnel-physics bound the imperative loop's measured rate is judged
-    # against.
+    # next #6, imperative mode only): programs/iter x ~7 ms + leaves x
+    # ~22 us — the tunnel-physics bound the imperative loop's measured
+    # rate is judged against.
     floor = _DCGAN_FLOOR_RE.search(stdout)
     if floor:
-        out["dcgan_main_amp_imperative_3scaler"].update(
+        out["dcgan_main_amp_3scaler"].update(
             dispatch_floor_ms=float(floor.group(1)),
             dispatch_floor_it_s=float(floor.group(2)))
     return out
@@ -883,8 +956,9 @@ def main():
 
     step2, state2, data2, step_fn2 = _make_resnet_step("O2", batch, size)
     # Copy the state BEFORE the donated jitted-per-step timing consumes
-    # it; the copy seeds the device-loop timing below.
+    # it; the copies seed the device-loop and pipeline timings below.
     state_dl = jax.tree_util.tree_map(jnp.copy, state2)
+    state_pl = jax.tree_util.tree_map(jnp.copy, state2)
     t_o2, state2 = _time_steps(step2, state2, data2, iters)
     prof_resnet, tp_resnet = (_prof_top_ops(step2, state2, data2)
                               if on_tpu else (None, None))
@@ -912,7 +986,12 @@ def main():
     # dispatch tax to <1 ms/step; real TPU loops chain hundreds).
     t_o2_dl = (_time_steps_device_loop(step_fn2, state_dl, data2)
                if on_tpu else t_o2)
-    del step2, state2, data2, state_dl
+    # The user-facing wall rate through runtime.StepPipeline — the
+    # ISSUE-2 acceptance pins it within 5% of the device-loop rate
+    # (the dispatch gap the step-pipelining runtime exists to close).
+    t_o2_pipe = (_time_steps_pipeline(step_fn2, state_pl, data2)
+                 if on_tpu else t_o2)
+    del step2, state2, data2, state_dl, state_pl
     # O2 precision machinery measured in isolation on the same param tree
     # (cast + unscale/overflow + masked SGD update as ONE program): the
     # honest numerator for "plumbing share of step" — the full-step trace
@@ -1045,6 +1124,11 @@ def main():
             # K=8 steps per program (apex_tpu.training.chain_steps): the
             # deployment-shape rate the headline img/s and MFU use.
             "ms_per_step_o2_device_loop": round(t_o2_dl * 1e3, 2),
+            # Wall rate of the USER-FACING path (runtime.StepPipeline,
+            # K steps/dispatch, deferred metric reads) — the gap between
+            # this and the device-loop number is the dispatch tax the
+            # step-pipelining runtime leaves on the table.
+            "ms_per_step_o2_pipeline_wall": round(t_o2_pipe * 1e3, 2),
             "ms_per_step_o0": round(t_o0 * 1e3, 2),
             "ms_per_step_o0_device_loop": round(t_o0_dl * 1e3, 2),
             "images_per_sec_o2": round(ips_o2, 2),
@@ -1121,6 +1205,26 @@ def main():
     # next #1/#6): the real entry points under examples/, unmodified.
     extra["examples"] = _bench_examples(on_tpu)
 
+    # Self-validation, same contract as the MFU gates above: a steady
+    # rate far below the example's own best window means the hot loop is
+    # stalling on dispatch/syncs again (the exact regression class the
+    # step-pipelining runtime closed — DCGAN sat at 12x for five
+    # rounds).  Target is <= _WINDOW_GAP_TARGET_PCT (ISSUE 2); the gate
+    # fails at _WINDOW_GAP_GATE_PCT to absorb the tunnel's pass-to-pass
+    # noise (~±18%) while still catching order-of-magnitude stalls.
+    if on_tpu:
+        for ex_key, label in (("imagenet_main_amp", "imagenet"),
+                              ("dcgan_main_amp_3scaler", "dcgan")):
+            gap = (extra["examples"].get(ex_key) or {}).get("window_gap_pct")
+            if gap is not None and gap > _WINDOW_GAP_GATE_PCT:
+                raise SystemExit(
+                    f"BENCH SELF-CHECK FAILED: {label} example steady "
+                    f"throughput trails its own best window by {gap}% "
+                    f"(> {_WINDOW_GAP_GATE_PCT}% gate; target "
+                    f"<= {_WINDOW_GAP_TARGET_PCT}%) — the example's hot "
+                    f"loop is stalling on dispatch or host syncs; "
+                    f"refusing to report.")
+
     # Regression guard vs the previous round (VERDICT r3 next #4): compare
     # each headline timing against the committed BENCH_PREV.json.
     prev = _load_prev_bench()
@@ -1181,14 +1285,20 @@ def main():
     if prof_resnet and "device_us_per_step" in (prof_resnet or {}):
         prof_dev_ms = round(prof_resnet["device_us_per_step"] / 1e3, 2)
     ex = extra["examples"].get("imagenet_main_amp", {})
-    dc = extra["examples"].get("dcgan_main_amp_imperative_3scaler", {})
+    dc = extra["examples"].get("dcgan_main_amp_3scaler", {})
     headline = {
         "metric": "resnet50_amp_o2_images_per_sec_per_chip",
         "value": round(ips_o2, 2),
         "unit": "images/sec",
         "vs_baseline": round(t_o0_dl / t_o2_dl, 3),
         "summary": {
-            "resnet50_ms_o2_wall": round(t_o2 * 1e3, 2),
+            # The user-facing training-path wall rate (StepPipeline):
+            # the ISSUE-2 acceptance compares this against the
+            # device-loop rate.  The jitted-PER-STEP wall time (which
+            # inherently pays ~7 ms dispatch per step through the
+            # tunnel) moved to resnet50_ms_o2_per_step_wall.
+            "resnet50_ms_o2_wall": round(t_o2_pipe * 1e3, 2),
+            "resnet50_ms_o2_per_step_wall": round(t_o2 * 1e3, 2),
             "resnet50_ms_o2_device_loop": round(t_o2_dl * 1e3, 2),
             "resnet50_ms_o2_device": prof_dev_ms,
             "resnet50_mfu_vs_measured_pct": (
@@ -1207,9 +1317,11 @@ def main():
             "imagenet_example_img_s_steady": ex.get("img_per_sec_steady"),
             "imagenet_example_img_s_best_window": ex.get(
                 "img_per_sec_best_window"),
+            "imagenet_example_window_gap_pct": ex.get("window_gap_pct"),
             "dcgan_example_it_s_steady": dc.get("it_per_sec_steady"),
             "dcgan_example_it_s_best_window": dc.get(
                 "it_per_sec_best_window"),
+            "dcgan_example_window_gap_pct": dc.get("window_gap_pct"),
             "measured_matmul_tflops": (
                 round(measured_med / 1e12, 1) if measured_med else None),
             "measured_matmul_tflops_band": (

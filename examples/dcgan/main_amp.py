@@ -1,9 +1,26 @@
 """DCGAN with amp — the TPU port of the reference
 ``examples/dcgan/main_amp.py:214-253``: two models, two optimizers, THREE
-losses with separate loss scalers (``amp.initialize(..., num_losses=3)``,
-``loss_id=0/1/2``), exercised through the imperative amp surface.
+losses with separate loss scalers.
+
+Two modes:
+
+* default — the step-pipelined path: the whole iteration (G forward,
+  both D backwards, D update, G backward, G update, all three dynamic
+  loss-scale machines) compiles into ONE program, and
+  :class:`apex_tpu.runtime.StepPipeline` chains ``--steps-per-call`` of
+  them per host dispatch with losses read back one dispatch behind.
+  This is the three-scaler stress test for the runtime: every scaler's
+  overflow flag stays a device-side select inside the scan carry.
+  (BENCH r05 measured the old imperative loop at 4.67 it/s steady
+  against 57 it/s best-window — 10 host dispatches per iteration; the
+  pipelined program is one dispatch per K iterations.)
+* ``--imperative`` — the reference-parity surface (``amp.initialize(...,
+  num_losses=3)``, ``scale_loss(loss_id=0/1/2)``, ``FusedAdam.step()``),
+  exercised through the imperative API exactly as the reference example
+  drives it.
 
     python main_amp.py --niter 1 --batchSize 64 --opt_level O1
+    python main_amp.py --niter 1 --imperative
 """
 
 import os as _os
@@ -22,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu import amp
+from apex_tpu import amp, runtime, training
 from apex_tpu.models import Generator, Discriminator
 from apex_tpu.optimizers import FusedAdam
 
@@ -40,8 +57,9 @@ def parse():
     p.add_argument("--opt_level", type=str, default="O1")
     p.add_argument("--print-freq", type=int, default=1,
                    help="print losses every N iters (0 = only the final "
-                   "iter); each print forces device->host loss fetches, "
-                   "whole round-trips on a tunneled chip")
+                   "iter); pipelined mode rounds the cadence to whole "
+                   "windows and reads one dispatch behind, so a print "
+                   "never drains the pipeline")
     p.add_argument("--data-pool", type=int, default=8,
                    help="pre-staged synthetic batches reused cyclically "
                    "(host->device upload happens before the timed loop, "
@@ -51,6 +69,14 @@ def parse():
                    "first iterations compile; the SECOND call of each "
                    "program can retrace too — jit caches on input "
                    "shardings, and step outputs come back committed)")
+    p.add_argument("--steps-per-call", type=int, default=8,
+                   help="pipelined mode: chain N whole GAN iterations "
+                   "(D phase + G phase + 3 scaler updates) into ONE "
+                   "compiled program via apex_tpu.runtime.StepPipeline")
+    p.add_argument("--imperative", action="store_true",
+                   help="run the reference-parity imperative amp surface "
+                   "(amp.initialize num_losses=3 + scale_loss loss_id + "
+                   "FusedAdam.step) instead of the pipelined runtime")
     return p.parse_args()
 
 
@@ -60,16 +86,205 @@ def bce_with_logits(logits, target):
                     + jnp.log1p(jnp.exp(-jnp.abs(z))))
 
 
-def main():
-    opt = parse()
-    key = jax.random.PRNGKey(0)
+def _build_models(opt, key):
     netG = Generator(ngf=opt.ngf, nc=3)
     netD = Discriminator(ndf=opt.ndf)
-
     z0 = jnp.ones((opt.batchSize, opt.nz))
     gv = netG.init(key, z0)
     img0 = netG.apply(gv, z0, train=False)
     dv = netD.init(jax.random.PRNGKey(1), img0)
+    return netG, netD, gv, dv
+
+
+def _synthetic_pool(opt):
+    """Pre-staged synthetic batches, uploaded ONCE before the timed loop
+    and cycled — the loop then measures the amp machinery, not host RNG
+    + host->device streaming (tens of MB/s on a tunneled chip).  The
+    reference gets the same effect from DALI/DataLoader prefetch."""
+    rng = np.random.RandomState(0)
+    return [(jnp.asarray(rng.randn(opt.batchSize, 64, 64, 3) * 0.5,
+                         jnp.float32),
+             jnp.asarray(rng.randn(opt.batchSize, opt.nz), jnp.float32))
+            for _ in range(max(1, opt.data_pool))]
+
+
+# -- pipelined mode: one program per K iterations -----------------------------
+
+def main_pipelined(opt):
+    """The runtime path: a pure ``step_fn(state, batch)`` carrying BOTH
+    parameter trees, both Adam states, and all three dynamic loss-scale
+    states; :class:`runtime.StepPipeline` scans it K iterations per host
+    dispatch.  Semantics match the imperative path: each loss has its own
+    scaler, the two D losses accumulate into one Adam step that skips if
+    EITHER overflowed, and the G phase sees the UPDATED discriminator."""
+    from apex_tpu.amp.loss_scaler import LossScaler
+
+    if opt.opt_level not in ("O0", "O1"):
+        raise SystemExit(f"pipelined dcgan supports O0/O1 (the reference "
+                         f"example's levels); got {opt.opt_level} — use "
+                         f"--imperative for the full opt-level surface")
+    if opt.opt_level == "O1":
+        amp.init()                      # O1 autocast inside the traced loss
+
+    key = jax.random.PRNGKey(0)
+    netG, netD, gv, dv = _build_models(opt, key)
+    g_state = {k: v for k, v in gv.items() if k != "params"}
+    d_state = {k: v for k, v in dv.items() if k != "params"}
+    real_label, fake_label = 1.0, 0.0
+
+    def d_loss_real(d_params, real):
+        out, _ = netD.apply({"params": d_params, **d_state}, real,
+                            train=True, mutable=["batch_stats"])
+        return bce_with_logits(out, real_label)
+
+    def d_loss_fake(d_params, fake):
+        out, _ = netD.apply({"params": d_params, **d_state}, fake,
+                            train=True, mutable=["batch_stats"])
+        return bce_with_logits(out, fake_label)
+
+    def g_loss(g_params, d_params, noise):
+        fake, _ = netG.apply({"params": g_params, **g_state}, noise,
+                             train=True, mutable=["batch_stats"])
+        out, _ = netD.apply({"params": d_params, **d_state}, fake,
+                            train=True, mutable=["batch_stats"])
+        return bce_with_logits(out, real_label)
+
+    # Three scalers, one per loss (the num_losses=3 contract), dynamic
+    # under amp exactly like amp.initialize's default.
+    dynamic = opt.opt_level != "O0"
+    scalers = [LossScaler("dynamic" if dynamic else 1.0) for _ in range(3)]
+    tx = training.adam(lr=opt.lr, beta1=opt.beta1, beta2=0.999)
+
+    state = {
+        "g": gv["params"], "d": dv["params"],
+        "g_opt": tx.init(gv["params"]), "d_opt": tx.init(dv["params"]),
+        "s0": scalers[0].init(), "s1": scalers[1].init(),
+        "s2": scalers[2].init(),
+    }
+
+    def step_fn(state, batch):
+        real, noise = batch
+        # (1) D phase: G forward (detached) + BOTH D backwards, each loss
+        # scaled by its own scaler; the two unscaled grads accumulate
+        # into ONE Adam step that skips when EITHER loss overflowed
+        # (apex semantics: backward-accumulate then step-or-skip).
+        fake, _ = netG.apply({"params": state["g"], **g_state}, batch[1],
+                             train=True, mutable=["batch_stats"])
+        fake = jax.lax.stop_gradient(fake)
+        errR, gR = jax.value_and_grad(
+            lambda p: jnp.float32(d_loss_real(p, real))
+            * state["s0"].loss_scale)(state["d"])
+        errF, gF = jax.value_and_grad(
+            lambda p: jnp.float32(d_loss_fake(p, fake))
+            * state["s1"].loss_scale)(state["d"])
+        gR, s0 = scalers[0].unscale(gR, state["s0"])
+        gF, s1 = scalers[1].unscale(gF, state["s1"])
+        mask_d = (jnp.logical_not(s0.overflow | s1.overflow)
+                  if dynamic else None)
+        g_d = jax.tree_util.tree_map(lambda a, b: a + b, gR, gF)
+        d_new, d_opt = tx.update(g_d, state["d_opt"], state["d"],
+                                 apply_mask=mask_d)
+        # (2) G phase, loss_id=2, against the UPDATED discriminator —
+        # same ordering as the imperative loop (optimizerD.step() runs
+        # before g_phase reads optimizerD.params).
+        errG, gG = jax.value_and_grad(
+            lambda p: jnp.float32(g_loss(p, d_new, noise))
+            * state["s2"].loss_scale)(state["g"])
+        gG, s2 = scalers[2].unscale(gG, state["s2"])
+        mask_g = jnp.logical_not(s2.overflow) if dynamic else None
+        g_new, g_opt = tx.update(gG, state["g_opt"], state["g"],
+                                 apply_mask=mask_g)
+        metrics = {
+            # unscaled for display (err* carry their loss's scale)
+            "loss_d": (errR / state["s0"].loss_scale
+                       + errF / state["s1"].loss_scale),
+            "loss_g": errG / state["s2"].loss_scale,
+            "scale": state["s2"].loss_scale,
+        }
+        new_state = {
+            "g": g_new, "d": d_new, "g_opt": g_opt, "d_opt": d_opt,
+            "s0": scalers[0].update_scale(s0),
+            "s1": scalers[1].update_scale(s1),
+            "s2": scalers[2].update_scale(s2),
+        }
+        return new_state, metrics
+
+    spc = max(1, opt.steps_per_call)
+    total = opt.niter * opt.iters_per_epoch
+    # Reused pool window: spc distinct pool batches stacked once — must
+    # NOT be donated (streamed real data would stage fresh windows via
+    # runtime.stage_windows and donate them).
+    pool = _synthetic_pool(opt)
+    window = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *(pool[i % len(pool)] for i in range(spc)))
+    pipe = runtime.StepPipeline(step_fn, spc, donate_window=False)
+
+    print_every = max(1, -(-opt.print_freq // spc)) \
+        if opt.print_freq > 0 else 0       # cadence in WINDOWS
+
+    t0 = time.perf_counter()
+    t_steady = None
+    warm_iters = 0
+    reader = runtime.DeferredMetrics()
+    ipe = opt.iters_per_epoch
+
+    def emit(wm):
+        """One window's loss lines from ONE stacked device->host
+        transfer, one dispatch behind the loop."""
+        vals = wm.fetch()
+        last = wm.n_valid - 1
+        it_done = wm.step + wm.n_valid
+        print(f"[{(it_done - 1) // ipe}/{opt.niter}]"
+              f"[{(it_done - 1) % ipe}/{ipe}] "
+              f"Loss_D: {np.ravel(vals['loss_d'])[last]:.4f} "
+              f"Loss_G: {np.ravel(vals['loss_g'])[last]:.4f}")
+
+    ci = 0
+    while reader.steps_pushed < total:
+        n_valid = min(spc, total - reader.steps_pushed)
+        state, metrics = pipe.step_window(state, window, n_valid)
+        prev = reader.push(metrics, n_valid)
+        if ci <= 1:
+            # Calls 0 AND 1 both compile (call 1 re-specializes on the
+            # committed output shardings); drain them synchronously so
+            # the steady clock starts after both.
+            reader.newest().fetch()
+            t_steady = time.perf_counter()
+            warm_iters = reader.steps_pushed
+        if prev is not None and print_every \
+                and (prev.step // spc) % print_every == 0:
+            emit(prev)
+        ci += 1
+    if reader.newest() is not None:
+        emit(reader.newest())             # doubles as the pipeline drain
+    t1 = time.perf_counter()
+    n_steady = total - warm_iters
+    if t_steady is not None and n_steady > 0:
+        print(f"steady {n_steady / (t1 - t_steady):.2f} it/s over "
+              f"{n_steady} iters (excl first 2 calls)")
+
+    # Best-of-3 windows under the repo's min-of-reps timing policy: one
+    # steady window can eat a multi-second tunnel stall; each timed
+    # window is 2 calls (2*spc iters) fenced by one stacked metric fetch.
+    if total >= spc and spc > 1:
+        best = float("inf")
+        for _ in range(3):
+            tw = time.perf_counter()
+            for _ in range(2):
+                state, metrics = pipe.step_window(state, window, spc)
+            runtime.WindowMetrics(0, spc, metrics).fetch()
+            best = min(best, (time.perf_counter() - tw) / (2 * spc))
+        print(f"best-of-3 windows: {1.0 / best:.2f} it/s "
+              f"({best * 1e3:.1f} ms/iter over {2 * spc}-iter windows)")
+    print(f"done in {t1 - t0:.1f}s ({total / (t1 - t0):.2f} it/s)")
+
+
+# -- imperative mode: the reference-parity amp surface ------------------------
+
+def main_imperative(opt):
+    key = jax.random.PRNGKey(0)
+    netG, netD, gv, dv = _build_models(opt, key)
 
     optimizerG = FusedAdam(gv["params"], lr=opt.lr, betas=(opt.beta1, 0.999))
     optimizerD = FusedAdam(dv["params"], lr=opt.lr, betas=(opt.beta1, 0.999))
@@ -131,17 +346,7 @@ def main():
             lambda p: jnp.float32(g_loss(p, d_params, noise)) * s2)(
                 g_params)
 
-    # Pre-staged synthetic batches: upload ONCE before the timed loop and
-    # cycle through them — the imperative loop then measures the amp
-    # machinery, not host RNG + host->device streaming (tens of MB/s on a
-    # tunneled chip).  The reference gets the same effect from DALI/
-    # DataLoader prefetch (examples/dcgan/main_amp.py:214-253 consumes a
-    # pre-built dataloader).
-    rng = np.random.RandomState(0)
-    pool = [(jnp.asarray(rng.randn(opt.batchSize, 64, 64, 3) * 0.5,
-                         jnp.float32),
-             jnp.asarray(rng.randn(opt.batchSize, opt.nz), jnp.float32))
-            for _ in range(max(1, opt.data_pool))]
+    pool = _synthetic_pool(opt)
 
     def train_iter(idx):
         """One imperative iteration — shared by the main loop AND the
@@ -253,6 +458,14 @@ def main():
           f"floor ~{floor_ms:.1f} ms/iter "
           f"({1000.0 / floor_ms:.1f} it/s tunnel-physics bound)")
     print(f"done in {t1 - t0:.1f}s ({total / (t1 - t0):.2f} it/s)")
+
+
+def main():
+    opt = parse()
+    if opt.imperative:
+        main_imperative(opt)
+    else:
+        main_pipelined(opt)
 
 
 if __name__ == "__main__":

@@ -4,6 +4,13 @@ entry point (``examples/imagenet/main_amp.py``): same CLI surface
 TPU-native mechanics (one jitted SPMD train step over a device mesh instead
 of hooks + NCCL; bf16 instead of fp16).
 
+The training loop runs on :class:`apex_tpu.runtime.StepPipeline`:
+``--steps-per-call K`` chains K steps into ONE compiled program, batch
+windows are staged on device through the prefetcher (H2D of window N+1
+overlaps the device loop of window N — the reference ``data_prefetcher``'s
+stream overlap, at window granularity), and metric prints read one
+dispatch behind so the hot loop never drains the pipeline on a scalar.
+
 Data: pass an ImageNet directory laid out as class subfolders of npy/JPEG
 files, or use --synthetic (default when no dir is given) for generated
 data.  The normalize epilogue (native C++) and threaded device prefetch
@@ -30,11 +37,13 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from apex_tpu import training
-from apex_tpu.data import PrefetchLoader, normalize_images, synthetic_imagenet
+from apex_tpu import runtime, training
+from apex_tpu.parallel import import_shard_map
+
+shard_map = import_shard_map()
+from apex_tpu.data import normalize_images, synthetic_imagenet
 from apex_tpu.models import (ResNet18, ResNet34, ResNet50, ResNet101,
                              ResNet152)
 from apex_tpu.training import make_train_step
@@ -70,11 +79,12 @@ def parse():
     p.add_argument("--steps-per-epoch", default=100, type=int)
     p.add_argument("--steps-per-call", default=1, type=int,
                    help="chain N train steps into ONE compiled program "
-                   "(apex_tpu.training.chain_steps) over the pre-staged "
-                   "synthetic pool — the TPU device-loop shape; host "
-                   "dispatch and metric fetches then cost once per N "
-                   "steps.  Synthetic data only (a real loader feeds "
-                   "per-step batches).")
+                   "(apex_tpu.runtime.StepPipeline) — the TPU device-loop "
+                   "shape; host dispatch and metric fetches then cost "
+                   "once per N steps.  Real-data runs stage stacked "
+                   "windows through the prefetcher (H2D overlaps the "
+                   "device loop); a ragged final window is padded and "
+                   "mask-gated on device, no retrace.")
     return p.parse_args()
 
 
@@ -103,6 +113,15 @@ def main():
 
     x0 = jnp.ones((2, args.image_size, args.image_size, 3), jnp.float32)
     variables = init_model.init(jax.random.PRNGKey(0), x0, train=True)
+    if args.sync_bn:
+        # init_model uses plain BatchNorm (SyncBatchNorm's collectives
+        # need the mesh, absent at init); adopt its stats under the sync
+        # module's names so the batch_stats pytree is structure-stable —
+        # the K-step scan carry requires it.
+        from apex_tpu.parallel import adopt_batchnorm_stats
+        variables = dict(
+            variables,
+            batch_stats=adopt_batchnorm_stats(variables["batch_stats"]))
 
     def loss_fn(p, ms, batch):
         xb, yb = batch
@@ -129,9 +148,6 @@ def main():
     state = init_fn(variables["params"], variables["batch_stats"])
 
     spc = max(1, args.steps_per_call)
-    if spc > 1 and not (args.synthetic or args.data is None):
-        raise SystemExit("--steps-per-call needs --synthetic (the device "
-                         "loop consumes a pre-staged batch stack)")
     if spc > 1 and args.prof > 0 and args.prof % spc:
         # The device loop advances spc steps per call; honor --prof at
         # call granularity rather than silently overrunning it.
@@ -147,93 +163,109 @@ def main():
         print(f"note: --print-freq {args.print_freq} rounded up to "
               f"{rounded} (multiple of --steps-per-call {spc})")
         args.print_freq = rounded
-    if spc > 1:
-        # Device loop: scan spc steps per program.  The batch stack's
-        # leading (step) axis stays unsharded; the per-step batch axis
-        # shards over the mesh as before.
-        step = jax.jit(shard_map(
-            training.chain_steps(step_fn), mesh=mesh,
-            in_specs=(P(), (P(None, "data"), P(None, "data"))),
-            out_specs=(P(), P())), donate_argnums=(0,))
-    else:
-        step = jax.jit(shard_map(
-            step_fn, mesh=mesh,
-            in_specs=(P(), (P("data"), P("data"))),
-            out_specs=(P(), P())), donate_argnums=(0,))
 
-    if args.synthetic or args.data is None:
-        # Synthetic data: pre-upload a fixed pool of batches ONCE and
-        # cycle it device-side.  Streaming per-step synthetic batches
-        # would measure host->device bandwidth (77 MB/step at b128/224),
-        # not training — the reference's synthetic smoke does the same
-        # with a single static batch.  Real-data runs below keep the
-        # threaded PrefetchLoader pipeline.
-        from jax.sharding import NamedSharding
-        data_sh = NamedSharding(mesh, P("data"))
+    synthetic = args.synthetic or args.data is None
+    # The device loop: spc steps per program over a [spc, batch, ...]
+    # window.  The window's leading (step) axis stays unsharded; the
+    # per-step batch axis shards over the mesh; the tail-mask is
+    # replicated.  Streaming (real-data) windows are fresh buffers and
+    # get donated with the state; the synthetic pool window is reused
+    # every call, so it must not be.
+    pipe = runtime.StepPipeline(
+        step_fn, spc,
+        wrap=lambda fn: shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(), (P(None, "data"), P(None, "data")), P()),
+            out_specs=(P(), P())),
+        donate_window=not synthetic)
+
+    data_sh = NamedSharding(mesh, P(None, "data"))
+    if synthetic:
+        # Synthetic data: pre-upload ONE stacked window and cycle it
+        # device-side.  Streaming per-step synthetic batches would
+        # measure host->device bandwidth (77 MB/step at b128/224), not
+        # training — the reference's synthetic smoke does the same with
+        # a single static batch.  Real-data runs below stage fresh
+        # windows through the threaded prefetcher instead.
         pool_n = 8
         pool = []
         for imgs, labels in synthetic_imagenet(args.batch_size,
                                                args.image_size,
                                                steps=pool_n):
-            pool.append((
-                jax.device_put(normalize_images(imgs), data_sh),
-                jax.device_put(np.asarray(labels, np.int32), data_sh)))
+            pool.append((normalize_images(imgs),
+                         np.asarray(labels, np.int32)))
+        stack = jax.device_put(
+            jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs),
+                *(pool[i % pool_n] for i in range(spc))),
+            data_sh)
         total = args.steps_per_epoch * args.epochs
-        if spc > 1:
-            # Stack the pool into ONE [spc, batch, ...] lookahead the
-            # device loop scans per call (device-side stack, done once).
-            stack = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs),
-                *(pool[i % pool_n] for i in range(spc)))
-            loader = (stack for _ in range(0, total, spc))
-        else:
-            loader = (pool[i % pool_n] for i in range(total))
+        windows = ((stack, spc) for _ in range(0, total, spc))
     else:
         from apex_tpu.data import directory_imagenet
         stream = directory_imagenet(args.data, args.batch_size,
                                     args.image_size)
-        loader = PrefetchLoader(
-            stream, transform=lambda b: (normalize_images(b[0]),
-                                         np.asarray(b[1], np.int32)))
-
-    def fetch_metrics(metrics):
-        """ONE device->host transfer per print window: stack the scalars
-        device-side first (each separate float() costs a full round-trip
-        through a tunneled chip).  Under the device loop metrics arrive
-        stacked [spc]; report the window's last step."""
-        packed = jnp.stack([jnp.ravel(metrics["loss"])[-1],
-                            jnp.ravel(metrics["loss_scale"])[-1]])
-        vals = np.asarray(packed)
-        return float(vals[0]), float(vals[1])
+        windows = runtime.stage_windows(
+            stream, spc,
+            transform=lambda b: (normalize_images(b[0]),
+                                 np.asarray(b[1], np.int32)),
+            device=data_sh)
 
     t0 = time.perf_counter()
-    t1 = n_done = 0
-    warm = 2 * spc                    # first TWO calls compile (see below)
-    for ci, batch_or_stack in enumerate(loader):
-        i = ci * spc                  # global step index of this call
-        if args.prof >= 0 and i >= args.prof:
+    reader = runtime.DeferredMetrics()
+    print_every = max(1, args.print_freq // spc)   # cadence in WINDOWS
+
+    def emit(wm):
+        """Print one window's iter line from its stacked metrics — ONE
+        device->host transfer per print, one dispatch behind the loop."""
+        vals = wm.fetch()
+        last = wm.n_valid - 1
+        loss = float(np.ravel(vals["loss"])[last])
+        scale = float(np.ravel(vals["loss_scale"])[last])
+        done = wm.step + wm.n_valid
+        ips = args.batch_size * done / (time.perf_counter() - t0)
+        print(f"iter {done - 1}  loss {loss:.4f}  "
+              f"speed {ips:.1f} img/s  loss_scale {scale:.0f}")
+
+    t1 = None
+    warm = 0
+    printed = -1        # window index of the last emitted print
+    window = None
+    for ci, (window, n_valid) in enumerate(windows):
+        if args.prof >= 0 and reader.steps_pushed >= args.prof:
             break
-        state, metrics = step(state, batch_or_stack)
+        state, metrics = pipe.step_window(state, window, n_valid)
+        prev = reader.push(metrics, n_valid)
         if ci <= 1:
             # Calls 0 AND 1 both compile: call 0 the initial trace, call 1
             # a re-specialization because the donated state returns with
             # the mesh's NamedSharding (jit caches on input shardings).
-            # Steady state starts after both (the reference's AverageMeter
-            # skips warmup the same way).
-            fetch_metrics(metrics)
+            # Drain them synchronously so the steady clock starts after
+            # both (the reference's AverageMeter skips warmup the same
+            # way).
+            reader.newest().fetch()
             t1 = time.perf_counter()
-        n_done = i + spc
-        if (i // spc) % max(1, args.print_freq // spc) == 0:
-            loss, scale = fetch_metrics(metrics)
-            dt = time.perf_counter() - t0
-            ips = args.batch_size * n_done / dt
-            print(f"iter {n_done - 1}  loss {loss:.4f}  "
-                  f"speed {ips:.1f} img/s  loss_scale {scale:.0f}")
-    # force completion before stopping the clock (block_until_ready is a
-    # no-op on the tunnel, so fetch one scalar of the final state)
-    float(jnp.ravel(jax.tree_util.tree_leaves(state.params)[-1])[0]
-          .astype(jnp.float32))
-    if n_done > warm:
+            warm = reader.steps_pushed
+        if prev is not None and (prev.step // spc) % print_every == 0:
+            emit(prev)
+            printed = prev.step // spc
+    if hasattr(windows, "close"):
+        # --prof break abandons the stream mid-epoch: release the
+        # prefetch producer thread and its staged device windows now
+        # rather than at GC time (no-op after normal exhaustion, and on
+        # the synthetic generator).
+        windows.close()
+    n_done = reader.steps_pushed
+    newest = reader.newest()
+    if newest is not None and (newest.step // spc) % print_every == 0 \
+            and newest.step // spc > printed:
+        emit(newest)     # the fetch doubles as the end-of-loop drain
+    else:
+        # force completion before stopping the clock (block_until_ready
+        # is a no-op on the tunnel; the stacked metric fetch drains the
+        # enqueued pipeline)
+        reader.last()
+    if n_done > warm and t1 is not None:
         steady = (args.batch_size * (n_done - warm)
                   / (time.perf_counter() - t1))
         # "first 2 calls", not "N compile iters": under the device loop
@@ -244,20 +276,19 @@ def main():
     # spc > 1 only: at one step per call the 2-call window is bounded by
     # the fixed metric-fetch round-trip (~0.5 s on the tunnel), so the
     # "best window" would measure fetch latency, not training.
-    if (args.synthetic or args.data is None) and n_done > warm and spc > 1:
+    if synthetic and n_done > warm and spc > 1 and window is not None:
         # Best-of-3 windows (the repo's min-of-reps policy, like the
         # DCGAN example): one steady window can eat a multi-second
         # tunnel stall that has nothing to do with training throughput.
         # Each window = 2 calls (2*spc steps) synced by one metric
         # fetch, so the fixed fetch round-trip amortizes over the
         # window; the best window is what the chip demonstrably does.
-        win_batch = batch_or_stack
         best = float("inf")
         for _ in range(3):
             t0w = time.perf_counter()
             for _ in range(2):
-                state, metrics = step(state, win_batch)
-            fetch_metrics(metrics)
+                state, metrics = pipe.step_window(state, window, spc)
+            runtime.WindowMetrics(0, spc, metrics).fetch()
             best = min(best, time.perf_counter() - t0w)
         print(f"best-window {args.batch_size * 2 * spc / best:.1f} img/s "
               f"over {2 * spc}-iter windows")

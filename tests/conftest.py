@@ -32,6 +32,26 @@ _ON_CHIP = bool(os.environ.get("APEX_TPU_TESTS"))
 
 import jax  # noqa: E402
 
+if not hasattr(jax, "shard_map"):
+    # Older jax (< 0.6) keeps shard_map under experimental and has no
+    # top-level re-export; publish one so the suite's
+    # ``from jax import shard_map`` imports resolve.  Mirrors
+    # apex_tpu.parallel.distributed.import_shard_map — inlined rather
+    # than imported because apex_tpu must not be imported before the
+    # default-device pin below (import-time dispatch would precede it).
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def _compat_shard_map(f=None, **kw):
+        kw.pop("check_vma", None)   # new-jax spelling; rep checking off
+        kw["check_rep"] = False
+        if f is None:               # decorator form: @shard_map(mesh=...)
+            return functools.partial(_compat_shard_map, **kw)
+        return _legacy_shard_map(f, **kw)
+
+    jax.shard_map = _compat_shard_map
+
 jax.config.update("jax_default_matmul_precision", "highest")
 if not _ON_CHIP:
     jax.config.update("jax_default_device", jax.devices("cpu")[0])

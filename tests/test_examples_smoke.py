@@ -52,11 +52,34 @@ def test_imagenet_example_sync_bn(monkeypatch, capsys):
 
 
 def test_dcgan_example_multi_loss(monkeypatch):
-    """The multi-model / multi-loss O1 path (reference dcgan/main_amp.py:
-    214-253 with 3 loss scalers)."""
+    """The multi-model / multi-loss O1 path (3 loss scalers), default
+    (step-pipelined) mode: the whole GAN iteration — both D backwards,
+    the G phase, and all three scaler machines — runs through
+    runtime.StepPipeline."""
     _run_example(monkeypatch, "examples/dcgan/main_amp.py", [
         "--batchSize", "8", "--ngf", "8", "--ndf", "8",
-        "--iters-per-epoch", "2", "--niter", "1"])
+        "--iters-per-epoch", "2", "--niter", "1", "--steps-per-call", "2"])
+
+
+def test_dcgan_example_multi_loss_imperative(monkeypatch):
+    """The reference-parity imperative surface (amp.initialize with
+    num_losses=3, scale_loss loss_id=0/1/2, FusedAdam.step — reference
+    dcgan/main_amp.py:214-253)."""
+    _run_example(monkeypatch, "examples/dcgan/main_amp.py", [
+        "--batchSize", "8", "--ngf", "8", "--ndf", "8",
+        "--iters-per-epoch", "2", "--niter", "1", "--imperative"])
+
+
+def test_imagenet_example_steps_per_call(monkeypatch, capsys):
+    """The K-step device loop through the example CLI (--prof rounds up
+    to whole calls; the ragged-tail path is covered by
+    tests/test_runtime.py on the stage_windows protocol)."""
+    _run_example(monkeypatch, "examples/imagenet/main_amp.py", [
+        "--synthetic", "--prof", "5", "-b", "8", "--image-size", "32",
+        "-a", "resnet18", "--epochs", "1", "--steps-per-epoch", "6",
+        "--opt-level", "O2", "--steps-per-call", "2", "--print-freq", "2"])
+    out = capsys.readouterr().out
+    assert "done" in out
 
 
 def test_distributed_example(monkeypatch):

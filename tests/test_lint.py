@@ -85,6 +85,63 @@ def test_j001_metadata_reads_are_not_syncs():
     assert _codes(ok) == []
 
 
+def test_j001_loop_target_from_array_iterable_flags():
+    """ISSUE-2 extension: iterating a jax array binds device values to
+    the loop target, so float()/.item()/np.asarray() on it inside the
+    for body is a per-iteration sync (the old tracking only followed
+    Assign bindings and missed exactly this)."""
+    bad = """
+    import jax.numpy as jnp
+
+    losses = jnp.ones(8)
+    for l in losses:
+        print(float(l))
+    """
+    findings = lint_source(textwrap.dedent(bad), "examples/demo.py")
+    assert [f.rule for f in findings] == ["J001"]
+    waived = bad.replace(
+        "print(float(l))",
+        "print(float(l))  # jaxlint: disable=J001 -- fixture")
+    assert _codes(waived, "examples/demo.py") == []
+
+
+def test_j001_zip_and_while_body_syncs_flag():
+    bad_zip = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    xs = jnp.ones((4, 2))
+    ys = jnp.ones(4)
+    for x, y in zip(xs, ys):
+        np.asarray(x)
+    """
+    assert _codes(bad_zip, "examples/demo.py") == ["J001"]
+    bad_while = """
+    import jax.numpy as jnp
+
+    x = jnp.ones(3)
+    while True:
+        v = x.item()
+        break
+    """
+    assert _codes(bad_while, "examples/demo.py") == ["J001"]
+
+
+def test_j001_scalar_loop_counters_stay_host_values():
+    """enumerate over a jax array: the VALUE target is arrayish, the
+    counter stays a Python int — float(i) must not flag."""
+    src = """
+    import jax.numpy as jnp
+
+    losses = jnp.ones(8)
+    for i, l in enumerate(losses):
+        print(float(i))
+    """
+    assert _codes(src, "examples/demo.py") == []
+    flagged = src.replace("float(i)", "float(l)")
+    assert _codes(flagged, "examples/demo.py") == ["J001"]
+
+
 # -- J002: jit of non-array Python args ---------------------------------------
 
 _J002_BAD = """

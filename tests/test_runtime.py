@@ -1,0 +1,210 @@
+"""Step-pipelining runtime (apex_tpu.runtime) — the CPU-backend tier-1
+matrix the ISSUE-2 acceptance names: K in {1, 4}, ragged epoch tails,
+and a dynamic-loss-scale overflow skip mid-window, each pinned to ONE
+compile per (K, shape) with ``prof.assert_trace_count`` and checked
+bit-for-bit against the jitted-per-step reference trajectory.
+
+Also the donation contract: ``chain_steps`` under
+``donate_argnums=(0, 1)`` must actually release the stacked window
+buffer (the [K, ...] stack is K full batches of HBM — the whole point
+of donating it), and ``StepPipeline(donate_window=False)`` must leave a
+reused pool window alive.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import runtime, training
+from apex_tpu.prof import assert_trace_count
+from apex_tpu.training import chain_steps, make_train_step
+
+
+def _loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+def _params():
+    return {"w": jnp.ones((4, 2), jnp.float32)}
+
+
+def _batches(n, seed=0, bad_step=None):
+    """n per-step batches; ``bad_step`` gets an inf target so the
+    dynamic scaler overflows exactly there."""
+    rng = np.random.RandomState(seed)
+    out = [(rng.randn(8, 4).astype(np.float32),
+            rng.randn(8, 2).astype(np.float32)) for _ in range(n)]
+    if bad_step is not None:
+        x, y = out[bad_step]
+        out[bad_step] = (x, np.full_like(y, np.inf))
+    return out
+
+
+def _reference(step_fn, state, batches):
+    """The jitted-per-step trajectory the pipeline must reproduce."""
+    step = jax.jit(step_fn)
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(m["loss"])
+    return state, np.asarray(jax.device_get(losses))
+
+
+def _assert_states_match(got, want):
+    for g, w in zip(jax.tree_util.tree_leaves(got.params),
+                    jax.tree_util.tree_leaves(want.params)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-5)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_pipeline_matches_per_step_reference(k):
+    """Full windows: K steps per dispatch == K jitted-per-step calls,
+    exactly, with ONE compile for the hot loop."""
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.1),
+                                       opt_level="O0")
+    batches = _batches(8)
+    ref_state, ref_losses = _reference(step_fn, init_fn(_params()), batches)
+
+    pipe = runtime.StepPipeline(step_fn, k=k)
+    state = init_fn(_params())
+    with assert_trace_count(pipe.loop, 1):
+        state, reader = pipe.run(
+            state, runtime.window_batches(iter(batches), k))
+    assert reader.steps_pushed == len(batches)
+    _assert_states_match(state, ref_state)
+    # the LAST window's per-step losses match the reference tail
+    last = np.ravel(reader.last()["loss"])
+    np.testing.assert_allclose(last[:k], ref_losses[-k:], rtol=1e-5)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_ragged_tail_no_retrace(k):
+    """An epoch tail shorter than K pads to the same [K, ...] shape and
+    runs through the (separately compiled, select-gated) tail program —
+    one compile each, and the padded steps must not advance the state."""
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.1),
+                                       opt_level="O0")
+    n = 2 * k + max(1, k - 1)          # two full windows + a ragged tail
+    batches = _batches(n)
+    ref_state, _ = _reference(step_fn, init_fn(_params()), batches)
+
+    pipe = runtime.StepPipeline(step_fn, k=k)
+    state = init_fn(_params())
+    with assert_trace_count(pipe.loop, 1), \
+            assert_trace_count(pipe.tail_loop, 1 if k > 1 else 0):
+        state, reader = pipe.run(
+            state, runtime.window_batches(iter(batches), k))
+    assert reader.steps_pushed == n
+    _assert_states_match(state, ref_state)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_overflow_skip_mid_window(k):
+    """Dynamic loss scaling: an overflow in the middle of a window must
+    skip that step's update ON DEVICE (no retrace, no host sync) and
+    land on the same params and loss scale as the per-step path."""
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.1),
+                                       opt_level="O2", loss_scale="dynamic")
+    batches = _batches(2 * k + 1, bad_step=k // 2)   # mid-first-window
+    ref_state, _ = _reference(step_fn, init_fn(_params()), batches)
+    assert float(ref_state.scaler.loss_scale) < 2.0 ** 16  # it DID overflow
+
+    pipe = runtime.StepPipeline(step_fn, k=k)
+    state = init_fn(_params())
+    with assert_trace_count(pipe.loop, 1):
+        state, reader = pipe.run(
+            state, runtime.window_batches(iter(batches), k))
+    _assert_states_match(state, ref_state)
+    assert float(state.scaler.loss_scale) == \
+        float(ref_state.scaler.loss_scale)
+    # per-step overflow flags came back as a stacked [K] device array
+    flags = np.ravel(reader.last()["overflow"])
+    assert flags.shape[0] == k
+
+
+def test_deferred_metrics_one_dispatch_behind():
+    reader = runtime.DeferredMetrics()
+    assert reader.push({"loss": jnp.float32(0.0)}, 4) is None
+    prev = reader.push({"loss": jnp.float32(1.0)}, 4)
+    assert prev is not None and prev.step == 0 and prev.n_valid == 4
+    assert reader.newest().step == 4
+    assert reader.steps_pushed == 8
+    host = reader.last()               # newest window, host values
+    np.testing.assert_allclose(host["loss"], 1.0)
+
+
+def test_window_batches_pad_and_drop():
+    batches = [(np.full((2,), i, np.float32),) for i in range(5)]
+    padded = list(runtime.window_batches(iter(batches), 2))
+    assert [n for _, n in padded] == [2, 2, 1]
+    # the pad repeats the last real batch to keep shapes static
+    last_window = padded[-1][0][0]
+    assert last_window.shape == (2, 2)
+    np.testing.assert_array_equal(last_window[0], last_window[1])
+    dropped = list(runtime.window_batches(iter(batches), 2, pad_tail=False))
+    assert [n for _, n in dropped] == [2, 2]
+
+
+def test_stage_windows_yields_device_arrays():
+    """stage_windows = window_batches staged through PrefetchLoader: the
+    yielded windows are already device arrays (the H2D happened on the
+    producer thread), n_valid passes through as a plain int."""
+    batches = [(np.full((2, 3), i, np.float32),) for i in range(5)]
+    out = list(runtime.stage_windows(iter(batches), 2))
+    assert [n for _, n in out] == [2, 2, 1]
+    leaf = out[0][0][0]
+    assert isinstance(leaf, jax.Array)
+    assert leaf.shape == (2, 2, 3)
+    np.testing.assert_array_equal(np.asarray(out[1][0][0][0]),
+                                  np.full((2, 3), 2, np.float32))
+
+
+def test_chain_steps_donates_window_buffer():
+    """donate_argnums=(0, 1) must release the stacked batch window.  On
+    the CPU backend a donated input is only deleted when XLA can alias
+    it onto an output, so the probe step echoes a window-shaped metrics
+    leaf; on TPU jaxlibs the window is an XLA buffer donor regardless."""
+    def echo_step(state, batch):
+        (x,) = batch
+        return state + jnp.sum(x), {"echo": x}
+
+    chained = jax.jit(chain_steps(echo_step), donate_argnums=(0, 1))
+    state = jnp.float32(0.0)
+    window = (jnp.ones((4, 8), jnp.float32),)
+    new_state, metrics = chained(state, window)
+    jax.block_until_ready(metrics["echo"])
+    assert window[0].is_deleted(), \
+        "stacked window survived donate_argnums=(0, 1)"
+    assert float(new_state) == 32.0
+
+
+def test_step_pipeline_donate_window_flag():
+    """donate_window=True consumes streamed windows; donate_window=False
+    keeps a reused pool window alive across calls (the synthetic-data
+    shape the examples use)."""
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.1),
+                                       opt_level="O0")
+    window, n = next(runtime.window_batches(iter(_batches(4)), 4))
+    window = jax.device_put(window)
+
+    pipe = runtime.StepPipeline(step_fn, k=4, donate_window=False)
+    state = init_fn(_params())
+    for _ in range(3):                       # reuse MUST be safe
+        state, metrics = pipe.step_window(state, window, n)
+    assert not any(getattr(l, "is_deleted", lambda: False)()
+                   for l in jax.tree_util.tree_leaves(window))
+    float(np.ravel(jax.device_get(metrics["loss"]))[-1])
+
+
+def test_pipeline_rejects_bad_k_and_n_valid():
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.1),
+                                       opt_level="O0")
+    with pytest.raises(ValueError):
+        runtime.StepPipeline(step_fn, k=0)
+    pipe = runtime.StepPipeline(step_fn, k=2)
+    window, _ = next(runtime.window_batches(iter(_batches(2)), 2))
+    with pytest.raises(ValueError):
+        pipe.step_window(init_fn(_params()), window, n_valid=0)

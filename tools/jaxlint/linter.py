@@ -640,6 +640,18 @@ class _ScopeWalker:
         # recurse into compound statements
         if isinstance(stmt, (ast.For, ast.AsyncFor)):
             new_vars = loop_vars | self._scalar_loop_vars(stmt)
+            # Loop targets drawn from an arrayish iterable hold arrays:
+            # ``for loss in losses: float(loss)`` is a per-iteration
+            # device round-trip exactly like ``float(losses[i])`` — the
+            # J001 extension of ISSUE 2 (the old tracking only followed
+            # Assign bindings, so iteration syncs in for/while bodies
+            # passed the sweep).  Scalar counters (range/enumerate) are
+            # excluded; zip over mixed iterables over-approximates, per
+            # the waiver contract.
+            if _is_arrayish(stmt.iter, self.arrayish):
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name) and n.id not in new_vars:
+                        self.arrayish.add(n.id)
             self._stmts(stmt.body, loop_depth + 1, new_vars)
             self._stmts(stmt.orelse, loop_depth, loop_vars)
         elif isinstance(stmt, ast.While):
