@@ -9,7 +9,12 @@
 //    host<->device staging (multi-threaded memcpy, saturates DRAM b/w);
 //  * the input-pipeline decode epilogue: uint8 HWC image -> normalized
 //    float32/bfloat16 NHWC batch (the data-loader bottleneck the reference
-//    delegates to DALI in examples/imagenet).
+//    delegates to DALI in examples/imagenet);
+//  * the fused augmentation epilogue (crop + horizontal flip + normalize
+//    in ONE pass over the pixels — three numpy passes otherwise);
+//  * a counter-based synthetic-batch generator (splitmix64 per 8-byte
+//    block): benchmark input generation without burning the GIL on
+//    Python-side np.random (ISSUE 3 — the imagenet synthetic pool).
 //
 // Build: g++ -O3 -march=native -shared -fPIC -pthread (see native.py).
 
@@ -92,7 +97,73 @@ void apex_u8_to_f32_nhwc(const uint8_t* src, float* dst, int64_t n_img,
   });
 }
 
+// Counter-based synthetic byte stream: block i of 8 bytes is
+// splitmix64(seed + i), so generation is embarrassingly parallel, and
+// the numpy fallback (same recurrence on a uint64 lattice) produces
+// bit-identical output — the two-tier install contract for synthetic
+// data.  Little-endian byte order (x86/ARM hosts; asserted in native.py).
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+void apex_synth_u8(uint8_t* dst, int64_t nbytes, uint64_t seed,
+                   int threads) {
+  int64_t blocks = (nbytes + 7) / 8;
+  // Chunk blocks so parallel_for's per-index lambda call doesn't
+  // dominate; each task fills a contiguous ~64 KB span.
+  const int64_t kSpan = 8192;  // blocks per task (64 KB)
+  int64_t tasks = (blocks + kSpan - 1) / kSpan;
+  parallel_for(tasks, threads, [&](int64_t t) {
+    int64_t lo = t * kSpan, hi = std::min(blocks, lo + kSpan);
+    for (int64_t i = lo; i < hi; ++i) {
+      uint64_t v = splitmix64(seed + static_cast<uint64_t>(i));
+      int64_t off = i * 8;
+      int64_t n = std::min<int64_t>(8, nbytes - off);
+      std::memcpy(dst + off, &v, static_cast<size_t>(n));
+    }
+  });
+}
+
+// Fused augmentation epilogue: per-image crop window (oy, ox) of
+// oh x ow out of h x w, optional horizontal flip, then the normalize
+// affine — ONE pass over the output pixels instead of crop + flip +
+// normalize as separate host passes (what DALI fuses on GPU for the
+// reference's imagenet pipeline).  offs is [n, 2] (oy, ox); flips is
+// [n] (0/1).  Parallel over images.
+void apex_crop_flip_norm_u8_f32(const uint8_t* src, float* dst, int64_t n,
+                                int64_t h, int64_t w, int64_t c,
+                                int64_t oh, int64_t ow,
+                                const int32_t* offs, const uint8_t* flips,
+                                const float* mean, const float* stddev,
+                                int threads) {
+  std::vector<float> scale(c), bias(c);
+  for (int64_t ch = 0; ch < c; ++ch) {
+    scale[ch] = 1.0f / (255.0f * stddev[ch]);
+    bias[ch] = -mean[ch] / stddev[ch];
+  }
+  parallel_for(n, threads, [&](int64_t i) {
+    int64_t oy = offs[2 * i], ox = offs[2 * i + 1];
+    bool flip = flips[i] != 0;
+    const uint8_t* img = src + i * h * w * c;
+    float* out = dst + i * oh * ow * c;
+    for (int64_t y = 0; y < oh; ++y) {
+      const uint8_t* row = img + ((oy + y) * w + ox) * c;
+      float* drow = out + y * ow * c;
+      for (int64_t x = 0; x < ow; ++x) {
+        const uint8_t* px = row + (flip ? (ow - 1 - x) : x) * c;
+        for (int64_t ch = 0; ch < c; ++ch) {
+          drow[x * c + ch] = px[ch] * scale[ch] + bias[ch];
+        }
+      }
+    }
+  });
+}
+
 // Simple checksum used by tests to verify the library loaded correctly.
-int64_t apex_runtime_abi_version() { return 1; }
+// v2: adds apex_synth_u8 + apex_crop_flip_norm_u8_f32 (ISSUE 3).
+int64_t apex_runtime_abi_version() { return 2; }
 
 }  // extern "C"

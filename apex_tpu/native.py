@@ -19,6 +19,7 @@ import numpy as np
 
 _CSRC = os.path.join(os.path.dirname(__file__), "csrc")
 _SO = os.path.join(_CSRC, "build", "libapex_tpu_runtime.so")
+_ABI_VERSION = 2      # v2: synth_u8 + crop_flip_norm (ISSUE 3)
 _lock = threading.Lock()
 _lib = None
 available = False
@@ -49,14 +50,41 @@ def _load():
             available = False
             _lib = False
             return None
-        path = _SO if os.path.exists(_SO) else _build()
+        src = os.path.join(_CSRC, "apex_runtime.cpp")
+        try:
+            stale = (not os.path.exists(_SO)
+                     or os.path.getmtime(_SO) < os.path.getmtime(src))
+        except OSError:
+            # Prebuilt .so shipped without the source: nothing to
+            # compare against (or rebuild from) — trust the ABI check.
+            stale = not os.path.exists(_SO)
+        path = _build() if stale else _SO
+        if path is None and os.path.exists(_SO):
+            # mtime said stale but no compiler is available (prebuilt
+            # .so shipped without g++; checkouts don't preserve mtimes):
+            # trust the ABI-version check below to judge the existing
+            # build rather than silently dropping to the numpy tier.
+            path = _SO
         if path is None:
             available = False
             _lib = False
             return None
         try:
             lib = ctypes.CDLL(path)
-            assert lib.apex_runtime_abi_version() == 1
+            if lib.apex_runtime_abi_version() != _ABI_VERSION:
+                # A stale build dir from an older checkout (mtime lies
+                # across git checkouts): rebuild once, then give up.
+                # Unlink first — rebuilding IN PLACE keeps the inode,
+                # and dlopen dedups by (st_dev, st_ino), so a second
+                # CDLL of the same path would return the stale handle.
+                try:
+                    os.remove(_SO)
+                except OSError:
+                    pass
+                path = _build()
+                lib = ctypes.CDLL(path) if path else None
+                assert lib is not None \
+                    and lib.apex_runtime_abi_version() == _ABI_VERSION
         except Exception:
             available = False
             _lib = False
@@ -70,6 +98,16 @@ def _load():
         lib.apex_u8_to_f32_nhwc.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.apex_synth_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int]
+        lib.apex_crop_flip_norm_u8_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int]
         _lib = lib
@@ -151,4 +189,90 @@ def u8_to_f32_nhwc(images: np.ndarray, mean: Sequence[float],
             std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), threads)
     else:
         out[:] = (images.astype(np.float32) / 255.0 - mean) / std
+    return out
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64 lattice — the numpy mirror of
+    the C++ generator, bit-identical by construction."""
+    z = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def synth_bytes(nbytes: int, seed: int,
+                threads: int = _DEFAULT_THREADS) -> np.ndarray:
+    """Counter-based pseudorandom byte stream: block ``i`` of 8 bytes is
+    ``splitmix64(seed + i)``.  Native tier fills the buffer in parallel
+    with zero GIL time; the numpy fallback computes the same lattice
+    (both little-endian — asserted below, not assumed).  This is the
+    synthetic-batch generator backing :func:`apex_tpu.data.
+    synthetic_imagenet` (ISSUE 3: Python-side ``np.random`` generation
+    was a measurable producer-side GIL burn)."""
+    if nbytes < 0:
+        raise ValueError(f"nbytes must be >= 0, got {nbytes}")
+    import sys
+    assert sys.byteorder == "little", \
+        "synth_bytes assumes a little-endian host (the C++ tier memcpys " \
+        "uint64 blocks); add a byteswap for big-endian targets"
+    seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+    out = np.empty(nbytes, np.uint8)
+    lib = _load()
+    if lib:
+        lib.apex_synth_u8(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            nbytes, ctypes.c_uint64(seed), threads)
+    else:
+        blocks = (nbytes + 7) // 8
+        lattice = np.arange(blocks, dtype=np.uint64) + np.uint64(seed)
+        with np.errstate(over="ignore"):
+            words = _splitmix64(lattice)
+        out[:] = words.view(np.uint8)[:nbytes]
+    return out
+
+
+def crop_flip_normalize(images: np.ndarray, out_size: int,
+                        offsets: np.ndarray, flips: np.ndarray,
+                        mean: Sequence[float], std: Sequence[float],
+                        threads: int = _DEFAULT_THREADS) -> np.ndarray:
+    """Fused augmentation epilogue: per-image ``out_size`` crop at
+    ``offsets[i] = (oy, ox)``, horizontal flip where ``flips[i]``, and
+    the ``(x/255 - mean)/std`` normalize — ONE pass over the output
+    pixels (the reference delegates exactly this fusion to DALI).
+    ``images`` is uint8 NHWC; returns float32 ``[n, out, out, c]``.
+    Randomness is the CALLER's job (pass offsets/flips), so both tiers
+    are deterministic and bit-comparable."""
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    oh = ow = int(out_size)
+    if oh > h or ow > w:
+        raise ValueError(f"crop {oh}x{ow} exceeds image {h}x{w}")
+    offsets = np.ascontiguousarray(offsets, np.int32).reshape(n, 2)
+    if (offsets[:, 0] < 0).any() or (offsets[:, 0] > h - oh).any() \
+            or (offsets[:, 1] < 0).any() or (offsets[:, 1] > w - ow).any():
+        raise ValueError("crop offsets out of bounds")
+    flips = np.ascontiguousarray(flips, np.uint8).reshape(n)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if mean.size != c or std.size != c:
+        raise ValueError("mean/std length must equal channel count")
+    out = np.empty((n, oh, ow, c), np.float32)
+    lib = _load()
+    if lib:
+        lib.apex_crop_flip_norm_u8_f32(
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, h, w, c, oh, ow,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), threads)
+    else:
+        for i in range(n):
+            oy, ox = int(offsets[i, 0]), int(offsets[i, 1])
+            crop = images[i, oy:oy + oh, ox:ox + ow]
+            if flips[i]:
+                crop = crop[:, ::-1]
+            out[i] = (crop.astype(np.float32) / 255.0 - mean) / std
     return out

@@ -22,6 +22,7 @@ test to pin "one compile, zero retraces".
 from .analysis import OpRecord, Profile, profile_function   # noqa: F401
 from .capture import (init, annotate, scope, trace,          # noqa: F401
                       dump_markers, MARKERS)
+from .ledger import loader_ledger                            # noqa: F401
 from .parse import (KernelRecord, TraceProfile, parse_trace,  # noqa: F401
                     attach_measured)
 from .trace_count import assert_trace_count, trace_count     # noqa: F401

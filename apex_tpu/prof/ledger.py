@@ -328,3 +328,39 @@ def bytes_ledger(fn, args, tp, steps: int = 1,
         joined.append(row)
     out["by_stage_joined"] = joined
     return out
+
+
+def loader_ledger(stats: Dict[str, Any],
+                  bytes_per_batch: Optional[float] = None) -> Dict[str, Any]:
+    """Input-engine counters in ledger form (ISSUE 3): join a
+    :meth:`apex_tpu.data.LoaderStats.snapshot` with derived utilization
+    percentages so the steady-vs-best-window gap decomposes into
+    attributed host-side time the same way :func:`bytes_ledger`
+    attributes HBM traffic.
+
+    * ``loader_stall_pct`` — consumer wait / wall: the fraction of the
+      training wall clock the INPUT engine cost (the regression-gated
+      number ``bench.py`` reports per example);
+    * ``producer_stall_pct`` — worker back-pressure / wall: > 0 means
+      the pipeline is producer-RICH (healthy — compute is the
+      bottleneck);
+    * ``stage_bw_gb_s`` — host->device staging dispatch bandwidth, when
+      ``bytes_per_batch`` is known.
+    """
+    out = dict(stats)
+    elapsed = float(stats.get("elapsed_s") or 0.0)
+    if elapsed > 0:
+        out["producer_stall_pct"] = round(
+            100.0 * float(stats.get("producer_stall_s", 0.0)) / elapsed, 2)
+        out["stage_pct"] = round(
+            100.0 * float(stats.get("stage_s", 0.0)) / elapsed, 2)
+    if bytes_per_batch and stats.get("stage_s"):
+        # stage_s accrues for every STAGED batch — the stager runs up to
+        # ``depth`` ahead of delivery and an abandoned stream staged
+        # more than it delivered; dividing by the delivered count would
+        # understate the dispatch bandwidth.
+        staged = stats.get("staged", stats.get("batches", 0))
+        out["stage_bw_gb_s"] = round(
+            staged * bytes_per_batch
+            / float(stats["stage_s"]) / 1e9, 2)
+    return out

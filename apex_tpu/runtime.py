@@ -270,51 +270,77 @@ def window_batches(batches: Iterable, k: int, *,
     """
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    for group in _group_batches(batches, k, pad_tail):
+        yield _assemble_window(group, k, transform)
+
+
+def _assemble_window(group, k: int, transform: Optional[Callable]):
+    """One window from one ``_group_batches`` group: per-batch
+    ``transform``, tail pad with the TRANSFORMED last batch (padding
+    before the transform would re-run the whole decode/augment ``k - n``
+    extra times), host stack.  Shared by :func:`window_batches` (caller
+    thread) and :func:`stage_windows` (worker pool) so the two paths
+    cannot diverge."""
+    items, n_valid = group
+    if transform is not None:
+        items = [transform(b) for b in items]
+    if len(items) < k:
+        items = items + [items[-1]] * (k - len(items))
+    window = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *items)
+    return window, n_valid
+
+
+def _group_batches(batches: Iterable, k: int, pad_tail: bool) -> Iterator:
+    """Group a batch stream into ``(list of <= k raw items, n_valid)``
+    pairs WITHOUT transforming, padding, or stacking — cheap enough to
+    sit under the :class:`~apex_tpu.data.PrefetchLoader` source lock;
+    the heavy per-window assembly (and the tail pad, AFTER the
+    transform, so the transform runs exactly once per source batch) is
+    the worker pool's job (see :func:`stage_windows`)."""
     buf = []
-
-    def _stack(group, n_valid):
-        window = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs), *group)
-        return window, n_valid
-
     for b in batches:
-        if transform is not None:
-            b = transform(b)
         buf.append(b)
         if len(buf) == k:
-            yield _stack(buf, k)
+            yield buf, k
             buf = []
     if buf and pad_tail:
-        n = len(buf)
-        buf = buf + [buf[-1]] * (k - n)
-        yield _stack(buf, n)
+        yield buf, len(buf)
 
 
 def stage_windows(batches: Iterable, k: int, *,
                   transform: Optional[Callable] = None,
                   pad_tail: bool = True, depth: int = 2,
-                  device=None):
-    """:func:`window_batches` staged through
-    :class:`apex_tpu.data.PrefetchLoader`: a producer thread stacks the
-    next ``depth`` windows and ``jax.device_put``s them eagerly, so the
-    host->device DMA of window N+1 overlaps the device loop of window N
-    (the reference ``data_prefetcher``'s stream-overlap, at window
-    granularity).  ``device`` may be a ``Sharding`` — e.g.
+                  device=None, workers: int = 1):
+    """Window assembly + device staging through the multi-worker
+    :class:`apex_tpu.data.PrefetchLoader` input engine: ``workers``
+    threads each assemble WHOLE ``[k, ...]`` windows ahead (per-batch
+    ``transform`` — decode/augment/normalize — plus the host stack, in
+    parallel, no per-batch barrier), and the staging thread
+    ``jax.device_put``s finished windows so the host->device DMA of
+    window N+1 overlaps the device loop of window N (the reference
+    ``data_prefetcher``'s stream-overlap, at window granularity).
+    ``device`` may be a ``Sharding`` — e.g.
     ``NamedSharding(mesh, P(None, "data"))`` to shard the per-step batch
     axis while the leading K axis stays unsharded.
 
     Returns the :class:`~apex_tpu.data.PrefetchLoader` itself — iterate
     it for ``(window, n_valid)`` pairs with ``window`` already on device
     (fresh buffers, safe to donate under
-    ``StepPipeline(donate_window=True)``), and ``close()`` it (or use it
-    as a context manager) to deterministically release the producer
-    thread and any staged device windows when abandoning the stream
-    early.
+    ``StepPipeline(donate_window=True)``); read ``.stats.snapshot()``
+    for the queue-depth / producer-stall / consumer-wait counters
+    (``loader_stall_pct``, the number ``bench.py`` reports per example);
+    and ``close()`` it (or use it as a context manager) to
+    deterministically release the worker threads and any staged device
+    windows when abandoning the stream early.
     """
     from .data import PrefetchLoader
 
-    host_windows = window_batches(batches, k, transform=transform,
-                                  pad_tail=pad_tail)
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
     # PrefetchLoader device_puts every leaf with a .shape — the window
     # arrays — and passes the plain-int n_valid through untouched.
-    return PrefetchLoader(host_windows, depth=depth, device=device)
+    return PrefetchLoader(_group_batches(batches, k, pad_tail),
+                          depth=depth, device=device,
+                          transform=lambda g: _assemble_window(
+                              g, k, transform),
+                          workers=workers)

@@ -44,6 +44,33 @@ def test_imagenet_example(monkeypatch, opt_level, capsys):
     assert "opt_level = " + opt_level in out
 
 
+def test_imagenet_example_real_data_worker_pool(monkeypatch, tmp_path,
+                                                capsys):
+    """The real-data input path end to end (ISSUE 3): directory source
+    (decode=False descriptors) -> 2-worker window assembly with the
+    fused crop/flip/normalize augment -> async device staging -> train
+    loop, plus the parseable loader-stall attribution line."""
+    import re
+
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(10):
+            np.save(d / f"s{i}.npy",
+                    rng.randint(0, 256, (64, 64, 3)).astype(np.uint8))
+    _run_example(monkeypatch, "examples/imagenet/main_amp.py", [
+        str(tmp_path), "--prof", "2", "-b", "8", "--image-size", "32",
+        "-a", "resnet18", "--epochs", "1", "--opt-level", "O2",
+        "--workers", "2", "--augment"])
+    out = capsys.readouterr().out
+    m = re.search(r"loader: stall ([\d.]+)%", out)   # bench._LOADER_RE
+    assert m, f"no loader attribution line in:\n{out[-2000:]}"
+    assert 0.0 <= float(m.group(1)) <= 100.0
+
+
 def test_imagenet_example_sync_bn(monkeypatch, capsys):
     _run_example(monkeypatch, "examples/imagenet/main_amp.py", [
         "--synthetic", "--prof", "2", "-b", "8", "--image-size", "32",

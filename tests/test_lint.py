@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J006 a known-bad snippet
+1. fixture self-tests — for every rule J001-J007 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -360,6 +360,92 @@ def test_j006_where_passes():
         return jnp.where(jnp.any(x > 0), x, -x)
     """
     assert _codes(fixed) == []
+
+
+# -- J007: per-step host staging in training loops ----------------------------
+
+_J007_BAD = """
+import jax
+import numpy as np
+
+for batch in loader:
+    x = jax.device_put(batch)
+    state = step(state, x)
+"""
+
+
+def test_j007_flags_per_step_device_put_on_batch():
+    assert _codes(_J007_BAD, "examples/demo.py") == ["J007"]
+
+
+def test_j007_flags_per_step_asarray_in_driver():
+    bad = """
+    import numpy as np
+
+    for images, labels in stream:
+        x = np.asarray(images, np.float32)
+        state = step(state, x, labels)
+    """
+    assert _codes(bad, "examples/demo.py") == ["J007"]
+
+
+def test_j007_asarray_in_library_loop_passes():
+    # the asarray half is scoped to DRIVER files: library code
+    # legitimately asarray's in serialization / metadata loops
+    src = """
+    import numpy as np
+
+    def save_all(leaves):
+        return [np.asarray(l) for l in leaves]
+
+    def stage(batches):
+        out = []
+        for b in batches:
+            out.append(np.asarray(b))
+        return out
+    """
+    assert _codes(src, "apex_tpu/fixture.py") == []
+
+
+def test_j007_device_put_flags_in_library_loops_too():
+    # device_put is flagged regardless of driver/library: re-staging
+    # per step is the same stall wherever it lives
+    src = """
+    import jax
+
+    def feed(batches):
+        for b in batches:
+            yield jax.device_put(b)
+    """
+    assert _codes(src, "apex_tpu/fixture.py") == ["J007"]
+
+
+def test_j007_waiver_and_loader_staging_pass():
+    waived = _J007_BAD.replace(
+        "x = jax.device_put(batch)",
+        "x = jax.device_put(batch)  # jaxlint: disable=J007 -- fixture")
+    assert _codes(waived, "examples/demo.py") == []
+    # the FIX: stage once via the loader, iterate device batches
+    fixed = """
+    import jax
+    from apex_tpu.data import PrefetchLoader
+
+    for batch in PrefetchLoader(stream, depth=2, workers=4):
+        state = step(state, batch)
+    """
+    assert _codes(fixed, "examples/demo.py") == []
+
+
+def test_j007_outside_loop_passes():
+    # one-time staging before the loop is the sanctioned pattern
+    src = """
+    import jax
+
+    window = jax.device_put(host_window)
+    for _ in range(10):
+        state = step(state, window)
+    """
+    assert _codes(src, "examples/demo.py") == []
 
 
 # -- J000: waiver hygiene -----------------------------------------------------

@@ -1,5 +1,13 @@
 """Native C++ runtime + input pipeline tests (reference apex_C
-flatten/unflatten contract + data_prefetcher semantics)."""
+flatten/unflatten contract + data_prefetcher semantics + the ISSUE-3
+multi-worker input engine: worker-pool delivery, error channel, shutdown
+under load, native synthetic generation, multi-epoch / sharded
+directory streaming).  The whole file must pass under
+``APEX_TPU_DISABLE_NATIVE=1`` too (two-tier install contract)."""
+
+import os
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -7,8 +15,25 @@ import pytest
 import jax.numpy as jnp
 
 from apex_tpu import native
-from apex_tpu.data import (PrefetchLoader, normalize_images,
-                           synthetic_imagenet, IMAGENET_MEAN, IMAGENET_STD)
+from apex_tpu.data import (BatchFiles, LoaderError, PrefetchLoader,
+                           augment_images, directory_imagenet, load_batch,
+                           normalize_images, synthetic_imagenet,
+                           format_loader_line,
+                           IMAGENET_MEAN, IMAGENET_STD)
+
+_NATIVE_DISABLED = bool(os.environ.get("APEX_TPU_DISABLE_NATIVE"))
+
+
+def _no_prefetch_threads():
+    return not any(t.name.startswith("apex-tpu-prefetch") and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def _await_prefetch_exit(timeout=5.0):
+    deadline = time.time() + timeout
+    while not _no_prefetch_threads() and time.time() < deadline:
+        time.sleep(0.05)
+    return _no_prefetch_threads()
 
 
 def _arrays():
@@ -28,6 +53,8 @@ def test_flatten_unflatten_roundtrip():
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.skipif(_NATIVE_DISABLED,
+                    reason="APEX_TPU_DISABLE_NATIVE forces the python tier")
 def test_native_library_builds():
     """The C++ tier should be active in this image (g++ baked in)."""
     native._load()
@@ -184,3 +211,375 @@ def test_directory_imagenet_decodes_jpeg(tmp_path):
     imgs, labels = batches[0]
     assert imgs.shape == (2, 32, 32, 3) and imgs.dtype == np.uint8
     assert set(np.unique([l for _, ls in batches for l in ls])) <= {0, 1}
+
+
+# -- native synthetic generation + fused augment (ISSUE 3) --------------------
+
+def test_synth_bytes_deterministic_and_ragged():
+    a = native.synth_bytes(1000, seed=7)
+    b = native.synth_bytes(1000, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.uint8 and a.shape == (1000,)
+    assert not np.array_equal(a, native.synth_bytes(1000, seed=8))
+    # ragged tail (not a multiple of the 8-byte block) and empty
+    assert native.synth_bytes(13, seed=1).shape == (13,)
+    np.testing.assert_array_equal(native.synth_bytes(13, seed=1),
+                                  native.synth_bytes(16, seed=1)[:13])
+    assert native.synth_bytes(0, seed=1).shape == (0,)
+    with pytest.raises(ValueError, match=">= 0"):
+        native.synth_bytes(-1, seed=0)
+
+
+def test_synthetic_imagenet_native_stream():
+    """The counter-based generator: deterministic in (seed, step),
+    distinct across steps, int32 labels in range."""
+    run1 = list(synthetic_imagenet(2, image_size=16, num_classes=10,
+                                   steps=3, seed=5))
+    run2 = list(synthetic_imagenet(2, image_size=16, num_classes=10,
+                                   steps=3, seed=5))
+    assert len(run1) == 3
+    for (i1, l1), (i2, l2) in zip(run1, run2):
+        assert i1.shape == (2, 16, 16, 3) and i1.dtype == np.uint8
+        assert l1.dtype == np.int32
+        assert (l1 >= 0).all() and (l1 < 10).all()
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_array_equal(l1, l2)
+    assert not np.array_equal(run1[0][0], run1[1][0])
+
+
+def test_augment_images_fused_matches_reference():
+    """The fused crop/flip/normalize epilogue == the three-pass numpy
+    reference, on whichever tier is active."""
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 256, (4, 12, 14, 3), dtype=np.uint8)
+    offsets = np.array([[0, 0], [4, 6], [2, 3], [1, 5]], np.int32)
+    flips = np.array([0, 1, 1, 0], np.uint8)
+    got = native.crop_flip_normalize(imgs, 8, offsets, flips,
+                                     IMAGENET_MEAN, IMAGENET_STD)
+    mean = np.asarray(IMAGENET_MEAN, np.float32)
+    std = np.asarray(IMAGENET_STD, np.float32)
+    for i in range(4):
+        oy, ox = offsets[i]
+        crop = imgs[i, oy:oy + 8, ox:ox + 8]
+        if flips[i]:
+            crop = crop[:, ::-1]
+        np.testing.assert_allclose(
+            got[i], (crop.astype(np.float32) / 255.0 - mean) / std,
+            atol=1e-5)
+    # the rng-driving wrapper: shape/dtype contract + determinism per rng
+    out = augment_images(imgs, 8, np.random.RandomState(0))
+    np.testing.assert_array_equal(
+        out, augment_images(imgs, 8, np.random.RandomState(0)))
+    assert out.shape == (4, 8, 8, 3) and out.dtype == np.float32
+
+
+def test_crop_flip_normalize_validates():
+    imgs = np.zeros((2, 8, 8, 3), np.uint8)
+    with pytest.raises(ValueError, match="exceeds"):
+        native.crop_flip_normalize(imgs, 9, np.zeros((2, 2), np.int32),
+                                   np.zeros(2, np.uint8),
+                                   IMAGENET_MEAN, IMAGENET_STD)
+    with pytest.raises(ValueError, match="out of bounds"):
+        native.crop_flip_normalize(imgs, 4,
+                                   np.array([[0, 0], [5, 0]], np.int32),
+                                   np.zeros(2, np.uint8),
+                                   IMAGENET_MEAN, IMAGENET_STD)
+
+
+# -- multi-worker engine: delivery, error channel, shutdown (ISSUE 3) ---------
+
+def test_prefetch_multiworker_ordered_delivery():
+    batches = [(np.full((2, 2), i, np.float32), i) for i in range(30)]
+    with PrefetchLoader(iter(batches), depth=2, workers=4,
+                        transform=lambda b: (b[0] * 2, b[1])) as lo:
+        out = list(lo)
+    assert [y for _, y in out] == list(range(30))
+    assert all(float(x[0, 0]) == 2 * i for i, (x, _) in enumerate(out))
+    snap = lo.stats.snapshot()
+    assert snap["batches"] == 30 and snap["produce_s"] >= 0.0
+
+
+def test_prefetch_multiworker_unordered_delivers_all():
+    batches = [(np.full((1,), i, np.float32),) for i in range(25)]
+    with PrefetchLoader(iter(batches), depth=3, workers=4,
+                        ordered=False) as lo:
+        seen = sorted(int(x[0]) for (x,) in lo)
+    assert seen == list(range(25))
+
+
+def test_prefetch_worker_crash_surfaces_original_exception():
+    """ISSUE-3 satellite: a transform crash on ANY worker mid-epoch must
+    deliver every earlier batch, then re-raise the ORIGINAL exception
+    object in the consumer — not a generic queue error, not a hang."""
+    boom = RuntimeError("decode exploded on a worker")
+
+    def transform(b):
+        if b[1] == 7:
+            raise boom
+        return b
+
+    batches = [(np.full((2,), i, np.float32), i) for i in range(20)]
+    got = []
+    with PrefetchLoader(iter(batches), depth=2, workers=3,
+                        transform=transform) as lo:
+        with pytest.raises(RuntimeError) as ei:
+            for b in lo:
+                got.append(b[1])
+    assert ei.value is boom
+    assert got == list(range(7))
+    assert _await_prefetch_exit(), "threads survived the crash"
+
+
+def test_prefetch_source_crash_multiworker():
+    """A crash in the SOURCE iterator itself (not the transform) takes
+    the same error channel."""
+    def gen():
+        for i in range(5):
+            yield (np.zeros((1,)), i)
+        raise OSError("source died")
+
+    with PrefetchLoader(gen(), depth=1, workers=3) as lo:
+        with pytest.raises(OSError, match="source died"):
+            n = 0
+            for _ in lo:
+                n += 1
+    assert n == 5
+
+
+def test_error_channel_is_a_class_not_a_tuple_sentinel():
+    """ISSUE-3 satellite regression: a legitimate batch that LOOKS like
+    the old ``("__error__", e)`` tuple must flow through as data, and no
+    numpy elementwise comparison warning may fire on normal batches."""
+    import warnings
+
+    sneaky = ("__error__", np.arange(3))
+    batches = [(np.arange(4), np.int32(0)), sneaky]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        with PrefetchLoader(iter(batches), depth=2) as lo:
+            out = list(lo)
+    assert len(out) == 2
+    assert out[1][0] == "__error__"      # delivered as data, not raised
+    assert isinstance(LoaderError(ValueError("x")).exc, ValueError)
+
+
+def test_prefetch_close_under_load_multiworker():
+    """ISSUE-3 satellite: ``close()`` while 4 workers are mid-transform
+    must leave no live pipeline threads and no staged batches (their
+    weakrefs die once the consumer's references drop)."""
+    import gc
+    import weakref
+
+    class _Probe:
+        pass                       # no .shape: staged as-is, queue holds
+                                   # the only reference
+
+    produced = []
+
+    def gen():
+        for _ in range(200):
+            p = _Probe()
+            produced.append(weakref.ref(p))
+            yield p
+
+    def slow_transform(p):
+        time.sleep(0.01)
+        return p
+
+    loader = PrefetchLoader(gen(), depth=3, workers=4,
+                            transform=slow_transform)
+    it = iter(loader)
+    first = next(it)
+    loader.close()
+    assert _await_prefetch_exit(), "pipeline threads survived close()"
+    # workers gave up early: at most lookahead (workers+depth) + depth
+    # staged + a few in flight of the 200 were ever produced
+    assert len(produced) < 50
+    assert next(it, None) is None    # close re-arms end-of-stream
+    del first, it
+    gc.collect()
+    alive = [r for r in produced if r() is not None]
+    assert len(alive) <= 1, f"{len(alive)} staged batches leaked"
+
+
+def test_loader_stats_line_matches_bench_regex():
+    """The ``loader: stall X%`` line the examples print is the bench.py
+    contract — keep the prefix parseable."""
+    import re
+
+    from apex_tpu.prof import loader_ledger
+
+    with PrefetchLoader(iter([(np.zeros((2,)),)] * 4), depth=1) as lo:
+        list(lo)
+    snap = lo.stats.snapshot()
+    for key in ("batches", "staged", "elapsed_s", "produce_s",
+                "producer_stall_s", "stage_s", "consumer_wait_s",
+                "mean_queue_depth", "loader_stall_pct"):
+        assert key in snap, key
+    assert snap["staged"] >= snap["batches"]
+    assert 0.0 <= snap["loader_stall_pct"] <= 100.0
+    line = format_loader_line(snap)
+    m = re.search(r"loader: stall ([\d.]+)%", line)   # bench._LOADER_RE
+    assert m and float(m.group(1)) == pytest.approx(
+        snap["loader_stall_pct"], abs=0.01)
+    led = loader_ledger(snap, bytes_per_batch=1e6)
+    if snap["elapsed_s"] > 0:
+        assert "producer_stall_pct" in led and "stage_pct" in led
+    if snap["stage_s"]:
+        assert led["stage_bw_gb_s"] > 0
+
+
+def test_prefetch_loader_rejects_bad_workers():
+    with pytest.raises(ValueError, match="workers"):
+        PrefetchLoader(iter([]), workers=0)
+
+
+def test_staging_failure_surfaces_not_hangs():
+    """Review fix: a device_put failure on the STAGING thread (OOM, an
+    unsupported leaf with a .shape attr) must travel the error channel —
+    an unhandled exception there would kill the thread and leave the
+    consumer blocked in q.get() forever."""
+    class Unstageable:
+        shape = (2,)              # claims stageability, device_put chokes
+
+    batches = [(np.zeros((2,)),), (Unstageable(),), (np.ones((2,)),)]
+    with PrefetchLoader(iter(batches), depth=1) as lo:
+        it = iter(lo)
+        next(it)                  # batch 0 stages fine
+        with pytest.raises(Exception):
+            next(it)              # batch 1: staging error, re-raised
+    assert _await_prefetch_exit(), "stager thread leaked after failure"
+
+
+# -- directory streaming: multi-epoch, sharded, decode=False (ISSUE 3) --------
+
+def _npy_tree(tmp_path, per_class=5, size=8):
+    rng = np.random.RandomState(0)
+    for cls in ("ant", "bee"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(per_class):
+            np.save(d / f"s{i}.npy",
+                    rng.randint(0, 256, (size, size, 3)).astype(np.uint8))
+    return str(tmp_path)
+
+
+def test_directory_imagenet_multi_epoch_reshuffle(tmp_path):
+    """ISSUE-3 satellite: per-epoch reshuffle with per-epoch drop_last —
+    every epoch yields the same number of full batches over the same
+    sample multiset, in a different (deterministic) order."""
+    root = _npy_tree(tmp_path, per_class=5)   # 10 samples, batch 4 -> 2
+    out = list(directory_imagenet(root, batch_size=4, image_size=8,
+                                  epochs=3, seed=11))
+    assert len(out) == 3 * 2                  # drop_last per epoch
+    epochs = [out[i:i + 2] for i in range(0, 6, 2)]
+    orders = [tuple(int(l) for _, ls in ep for l in ls) for ep in epochs]
+    assert orders[0] != orders[1] or orders[1] != orders[2], \
+        "epochs were not reshuffled"
+    # determinism: the same seed replays the same epoch orders
+    replay = list(directory_imagenet(root, batch_size=4, image_size=8,
+                                     epochs=3, seed=11))
+    for (a, la), (b, lb) in zip(out, replay):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+    # epochs=None streams forever: pull past one epoch and stop
+    import itertools
+    unending = directory_imagenet(root, batch_size=4, image_size=8,
+                                  epochs=None)
+    assert len(list(itertools.islice(unending, 5))) == 5
+    unending.close()
+
+
+def test_directory_imagenet_host_shard(tmp_path):
+    """Per-host sharding: hosts split each epoch's batch stream
+    disjointly and exhaustively (batch granularity, shared shuffle)."""
+    root = _npy_tree(tmp_path, per_class=8)   # 16 samples, batch 2 -> 8
+    full = list(directory_imagenet(root, batch_size=2, image_size=8,
+                                   seed=3))
+    shards = [list(directory_imagenet(root, batch_size=2, image_size=8,
+                                      seed=3, host_shard=(i, 2)))
+              for i in range(2)]
+    assert len(shards[0]) == len(shards[1]) == len(full) // 2
+    interleaved = [b for pair in zip(*shards) for b in pair]
+    for (a, la), (b, lb) in zip(full, interleaved):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+    with pytest.raises(ValueError, match="host_shard"):
+        list(directory_imagenet(root, batch_size=2, image_size=8,
+                                host_shard=(2, 2)))
+
+
+def test_directory_imagenet_host_shard_equal_counts(tmp_path):
+    """Review fix: when the per-epoch batch count does not divide over
+    the hosts, the remainder is dropped on EVERY host — unequal counts
+    would deadlock SPMD collectives at the epoch boundary."""
+    root = _npy_tree(tmp_path, per_class=9)   # 18 samples, batch 2 -> 9
+    counts = [len(list(directory_imagenet(root, batch_size=2,
+                                          image_size=8, seed=0,
+                                          epochs=2, host_shard=(i, 2))))
+              for i in range(2)]
+    assert counts[0] == counts[1] == 2 * (9 // 2)
+
+
+def test_batchfiles_seq_is_global_across_epochs(tmp_path):
+    """Review fix: ``BatchFiles.seq`` must keep counting across epochs
+    so per-batch augmentation seeds derived from it never repeat, even
+    when an epoch reshuffle leads a batch with the same file."""
+    root = _npy_tree(tmp_path, per_class=4)   # 8 samples, batch 4 -> 2
+    tasks = list(directory_imagenet(root, batch_size=4, image_size=8,
+                                    epochs=3, decode=False))
+    assert [t.seq for t in tasks] == list(range(6))
+
+
+def test_directory_decode_false_through_worker_pool(tmp_path):
+    """The decode=False protocol: the source yields cheap BatchFiles
+    descriptors; the worker pool decodes whole batches via load_batch
+    in the transform (no per-batch map barrier)."""
+    root = _npy_tree(tmp_path, per_class=4, size=8)   # 8 samples
+    stream = directory_imagenet(root, batch_size=2, image_size=8,
+                                decode=False, shuffle=False)
+    first = next(stream)
+    assert isinstance(first, BatchFiles) and len(first.paths) == 2
+    rest = list(stream)
+    with PrefetchLoader(
+            iter([first] + rest), depth=2, workers=2,
+            transform=lambda t: (normalize_images(load_batch(t)[0]),
+                                 load_batch(t)[1])) as lo:
+        out = list(lo)
+    assert len(out) == 4
+    for x, y in out:
+        assert x.shape == (2, 8, 8, 3) and x.dtype == jnp.float32
+        assert y.shape == (2,)
+
+
+def test_stage_windows_multiworker_roundtrip():
+    """stage_windows on the multi-worker engine: whole [k, ...] windows
+    assembled in the pool, delivered in order with n_valid tails, and
+    the transform runs EXACTLY once per source batch (review fix: the
+    ragged-tail pad happens after the transform, not before)."""
+    import itertools
+
+    from apex_tpu import runtime
+
+    calls = itertools.count()
+
+    def transform(b):
+        next(calls)
+        return b
+
+    batches = [(np.full((2, 3), i, np.float32), np.int32(i))
+               for i in range(7)]
+    with runtime.stage_windows(iter(batches), 3, workers=2,
+                               depth=2, transform=transform) as lo:
+        wins = list(lo)
+    assert next(calls) == 7                       # once per source batch
+    assert [n for _, n in wins] == [3, 3, 1]      # ragged tail padded
+    for j, (win, _) in enumerate(wins):
+        assert win[0].shape == (3, 2, 3)
+        for s in range(min(3, 7 - 3 * j)):
+            assert float(win[0][s, 0, 0]) == 3 * j + s
+    # the pad rows replicate the transformed LAST real batch
+    assert float(wins[2][0][0][2, 0, 0]) == 6.0
+    assert lo.stats.snapshot()["batches"] == 3
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        runtime.stage_windows(iter(batches), 0)

@@ -17,7 +17,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.jaxlint",
         description="Tracing-safety & dtype-discipline static analyzer "
-                    "for the apex_tpu stack (rules J001-J006; see "
+                    "for the apex_tpu stack (rules J001-J007; see "
                     "docs/jaxlint.md).")
     ap.add_argument("paths", nargs="*",
                     help="files or directory trees to lint "

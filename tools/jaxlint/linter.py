@@ -29,6 +29,13 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
 * **J006** Python control flow (``if``/``while``) branching on traced
   values inside a jitted function — trace-time concretization errors,
   or worse, silent trace-time specialization.
+* **J007** per-step host staging: ``jax.device_put`` / ``np.asarray`` /
+  ``jnp.asarray`` applied to batch data (a loop target drawn from a
+  host iterable — a loader/stream) inside a loop body.  Host->device
+  staging belongs in the input engine
+  (:class:`apex_tpu.data.PrefetchLoader` / ``stage_windows``), where it
+  overlaps compute, not on the hot loop where it serializes with it
+  (ISSUE 3: the input-side twin of the J001 sync stalls).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -59,6 +66,8 @@ RULES: Dict[str, str] = {
     "J004": "retracing hazard (jitted callable fed varying Python scalars)",
     "J005": "use-after-donate of a donate_argnums buffer",
     "J006": "Python control flow branching on a traced value under jit",
+    "J007": "per-step host staging (device_put/asarray on batch data in a "
+            "loop; stage in the loader)",
 }
 
 # Functions whose *contract* is the host boundary: serialization must
@@ -616,6 +625,10 @@ class _ScopeWalker:
         # the dominant idiom and would drown real syncs in false
         # positives; precision over recall.
         self.arrayish: Set[str] = set()
+        # Loop targets drawn from NON-array host iterables (a loader /
+        # batch stream): per-step device_put/asarray on these is the
+        # J007 host-staging-in-the-hot-loop finding.
+        self.batch_vars: Set[str] = set()
         self.jit_scoped = (fn is not None
                            and fn.name in self.idx.jitted_defs)
         self._stmts(body, loop_depth=0, loop_vars=frozenset())
@@ -652,6 +665,13 @@ class _ScopeWalker:
                 for n in ast.walk(stmt.target):
                     if isinstance(n, ast.Name) and n.id not in new_vars:
                         self.arrayish.add(n.id)
+            else:
+                # Non-array iterable (a loader / host batch stream):
+                # its non-scalar targets are host BATCH data — J007
+                # territory when device_put/asarray'd per step.
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name) and n.id not in new_vars:
+                        self.batch_vars.add(n.id)
             self._stmts(stmt.body, loop_depth + 1, new_vars)
             self._stmts(stmt.orelse, loop_depth, loop_vars)
         elif isinstance(stmt, ast.While):
@@ -737,6 +757,7 @@ class _ScopeWalker:
                     if isinstance(sub, ast.Call):
                         self._check_j001_call(sub, loop_depth)
                         self._check_j004_call(sub, loop_depth, loop_vars)
+                        self._check_j007_call(sub, loop_depth)
         # While tests live on the stmt itself
         if isinstance(stmt, ast.While):
             self._check_j006(stmt)
@@ -774,6 +795,39 @@ class _ScopeWalker:
             f"host sync {sync} {where} — blocks dispatch until the device "
             f"round-trip completes; keep the value on device or waive with "
             f"a reason"))
+
+    # .. J007 .................................................................
+
+    _J007_STAGING_CALLS = ("jax.device_put", "np.asarray", "numpy.asarray",
+                           "jnp.asarray", "np.array", "numpy.array")
+
+    def _check_j007_call(self, call: ast.Call, loop_depth: int) -> None:
+        if loop_depth == 0 or not call.args:
+            return
+        d = _dotted(call.func)
+        if d not in self._J007_STAGING_CALLS:
+            return
+        if d != "jax.device_put" and not self.driver:
+            # The asarray-family half targets TRAINING loops (driver
+            # scripts): library code legitimately asarray's inside
+            # serialization / per-leaf metadata loops, and its real
+            # sync hazards are J001's (arrayish) business.
+            return
+        arg = call.args[0]
+        names = {n.id for n in ast.walk(arg) if isinstance(n, ast.Name)}
+        hit = bool(names & self.batch_vars)
+        if d == "jax.device_put" and not hit:
+            # Re-staging values that are already device arrays is the
+            # same per-step stall, whatever name they travel under.
+            hit = _is_arrayish(arg, self.arrayish)
+        if not hit:
+            return
+        self.findings.append(Finding(
+            self.path, call.lineno, call.col_offset, "J007",
+            f"per-step host staging {d} on batch data inside a loop — "
+            f"host->device staging belongs in the input engine "
+            f"(PrefetchLoader / stage_windows device=...), where it "
+            f"overlaps compute instead of serializing with each step"))
 
     # .. J004 .................................................................
 
