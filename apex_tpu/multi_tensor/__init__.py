@@ -27,69 +27,166 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from .buckets import BucketStore, Packed
+
 __all__ = [
     "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm",
     "multi_tensor_maxnorm", "multi_tensor_lamb_stage1",
     "multi_tensor_lamb_stage2", "tree_finite", "MultiTensorApply",
     "multi_tensor_applier", "flatten", "unflatten",
+    "BucketStore", "Packed",
 ]
 
 
+def _is_float_leaf(x) -> bool:
+    # Inspect ``x.dtype`` directly — no jnp.asarray round-trip just to
+    # read metadata; non-array leaves (no dtype) fall through unchanged.
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jnp.floating)
+
+
 def _float_leaves(tree):
-    return [x for x in jax.tree_util.tree_leaves(tree)
-            if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    return [x for x in jax.tree_util.tree_leaves(tree) if _is_float_leaf(x)]
 
 
-def tree_finite(tree) -> jnp.ndarray:
-    """Device-side bool: every float leaf of ``tree`` is finite."""
+def _as_packed(tree, store: BucketStore):
+    """(packed, was_packed) — route a pytree or an already-Packed value
+    through a store."""
+    if isinstance(tree, Packed):
+        return tree, True
+    if store is None:
+        raise ValueError(
+            "mixing a Packed operand with a pytree operand requires the "
+            "store= that packed it (the index map to pack the other side)")
+    return store.pack(tree), False
+
+
+def tree_finite(tree, store: Optional[BucketStore] = None) -> jnp.ndarray:
+    """Device-side bool: every float leaf of ``tree`` is finite.
+
+    With ``store`` (or an already-:class:`Packed` ``tree``) the check is
+    ONE ``isfinite``+reduce per *bucket* instead of per leaf — the
+    O(leaves)->O(buckets) overflow check.
+    """
+    if store is not None or isinstance(tree, Packed):
+        packed = tree if isinstance(tree, Packed) else store.pack(tree)
+        # BucketStore puts EVERY float leaf in a bucket; .rest is
+        # non-float by construction, so the buckets are the whole check.
+        flags = [jnp.all(jnp.isfinite(b)) for b in packed.data]
+        if not flags:
+            return jnp.asarray(True)
+        return jnp.all(jnp.stack(flags))
     leaves = _float_leaves(tree)
     if not leaves:
         return jnp.asarray(True)
     return jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))
 
 
-def multi_tensor_scale(tree, scale, out_dtype=None) -> Tuple[Any, jnp.ndarray]:
+def multi_tensor_scale(tree, scale, out_dtype=None,
+                       store: Optional[BucketStore] = None
+                       ) -> Tuple[Any, jnp.ndarray]:
     """``out = in * scale`` over every float leaf; returns (out, overflow).
 
     Equivalent of ``amp_C.multi_tensor_scale`` (``csrc/
     multi_tensor_scale_kernel.cu:18-77``): the scaled value is checked for
     finiteness and a device-side flag raised on inf/NaN.  Used for loss
     unscaling and master<->model copies (scale=1.0).
+
+    With ``store`` (or a :class:`Packed` input, which also returns
+    Packed) the scale and the overflow check run per bucket.
     """
+    if store is not None or isinstance(tree, Packed):
+        packed, was_packed = _as_packed(tree, store)
+        data, flags = [], []
+        for x in packed.data:
+            y = jnp.asarray(x, jnp.float32) * scale
+            y = y.astype(out_dtype or x.dtype)
+            data.append(y)
+            flags.append(jnp.all(jnp.isfinite(y)))
+        out = Packed(data=tuple(data), rest=packed.rest)
+        overflow = (jnp.logical_not(jnp.all(jnp.stack(flags)))
+                    if flags else jnp.asarray(False))
+        if not was_packed:
+            out = store.unpack(out)
+        return out, overflow
+
     def one(x):
-        if not (hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)):
+        if not _is_float_leaf(x):
             return x
         y = jnp.asarray(x, jnp.float32) * scale
-        return y.astype(out_dtype or jnp.asarray(x).dtype)
+        return y.astype(out_dtype or x.dtype)
     out = jax.tree_util.tree_map(one, tree)
     return out, jnp.logical_not(tree_finite(out))
 
 
-def multi_tensor_axpby(x_tree, y_tree, a, b, out_dtype=None) -> Tuple[Any, jnp.ndarray]:
+def multi_tensor_axpby(x_tree, y_tree, a, b, out_dtype=None,
+                       store: Optional[BucketStore] = None
+                       ) -> Tuple[Any, jnp.ndarray]:
     """``out = a*x + b*y`` leafwise, overflow-checked.
 
     Equivalent of ``amp_C.multi_tensor_axpby``
     (``csrc/multi_tensor_axpby_kernel.cu:16-90``) — the gradient-accumulation
-    unscale (new_grad/scale + stashed_grad).
+    unscale (new_grad/scale + stashed_grad).  ``store`` routes the sweep
+    and the overflow check through buckets.
     """
+    if store is not None or isinstance(x_tree, Packed):
+        px, was_packed = _as_packed(x_tree, store)
+        py, _ = _as_packed(y_tree, store)
+        data, flags = [], []
+        for x, y in zip(px.data, py.data):
+            o = a * jnp.asarray(x, jnp.float32) + b * jnp.asarray(y, jnp.float32)
+            o = o.astype(out_dtype or x.dtype)
+            data.append(o)
+            flags.append(jnp.all(jnp.isfinite(o)))
+        out = Packed(data=tuple(data), rest=px.rest)
+        overflow = (jnp.logical_not(jnp.all(jnp.stack(flags)))
+                    if flags else jnp.asarray(False))
+        if not was_packed:
+            out = store.unpack(out)
+        return out, overflow
+
     def one(x, y):
-        if not (hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)):
+        if not _is_float_leaf(x):
             return x
         out = a * jnp.asarray(x, jnp.float32) + b * jnp.asarray(y, jnp.float32)
-        return out.astype(out_dtype or jnp.asarray(x).dtype)
+        return out.astype(out_dtype or x.dtype)
     out = jax.tree_util.tree_map(one, x_tree, y_tree)
     return out, jnp.logical_not(tree_finite(out))
 
 
-def multi_tensor_l2norm(tree, per_tensor: bool = False):
+def multi_tensor_l2norm(tree, per_tensor: bool = False,
+                        store: Optional[BucketStore] = None):
     """Global L2 norm over all float leaves; optionally per-tensor norms too.
 
     Equivalent of ``amp_C.multi_tensor_l2norm``
     (``csrc/multi_tensor_l2norm_kernel.cu:16-77, 237``).  Accumulation is
     fp32 regardless of leaf dtype, like the reference's float accumulators.
 
+    With ``store`` the global norm is one reduction per bucket, and the
+    per-tensor norms come from one segment reduction per bucket over the
+    index map (returned in flattened-leaf order, like the leafwise path).
+
     Returns ``global_norm`` or ``(global_norm, per_tensor_norms_list)``.
     """
+    if store is not None or isinstance(tree, Packed):
+        if per_tensor and store is None:
+            raise ValueError("per_tensor norms over a Packed input need "
+                             "the store (the per-leaf index map)")
+        packed = tree if isinstance(tree, Packed) else store.pack(tree)
+        if not packed.data:
+            zero = jnp.float32(0)
+            return (zero, []) if per_tensor else zero
+        if not per_tensor:
+            sq = [jnp.sum(jnp.square(jnp.asarray(x, jnp.float32)))
+                  for x in packed.data]
+            return jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        seg_sums = store.per_leaf_sq_sums(packed.data)
+        total = jnp.sqrt(jnp.sum(jnp.stack([jnp.sum(s) for s in seg_sums])))
+        by_leaf = {}
+        for b, sums in zip(store.buckets, seg_sums):
+            for pos, leaf_id in enumerate(b.leaf_ids):
+                by_leaf[leaf_id] = jnp.sqrt(sums[pos])
+        return total, [by_leaf[i] for i in store.leaf_order()]
     leaves = _float_leaves(tree)
     if not leaves:
         zero = jnp.float32(0)

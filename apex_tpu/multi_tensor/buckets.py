@@ -1,0 +1,345 @@
+"""Flat-bucket parameter engine: O(buckets) hot paths over whole models.
+
+The reference's ``multi_tensor_apply`` (``csrc/multi_tensor_apply.cuh``)
+exists to make whole-model elementwise work cost O(1) kernel launches
+instead of O(tensors).  The pytree port preserved the *dispatch* half of
+that capability (one jitted program), but the program itself — and the
+jit call boundary — still scaled with the leaf count: one HLO reduction
+per leaf in ``tree_finite``, one update subgraph per leaf in
+``adam_update``, and ~22 us of per-argument marshalling for every
+master/momentum buffer on every call (measured: 16.9 ms wall vs 4.8 ms
+device for the ~790-leaf BERT FusedAdam step).
+
+:class:`BucketStore` collapses that to O(buckets): the float leaves of a
+pytree are packed into a few large 1-D buffers, one per ``(dtype,
+weight-decay-flag)`` key — the same trick apex's DDP Reducer and
+PyTorch's ``_flatten_dense_tensors`` use for bucketed allreduce.  The
+index map (offset/size/shape per leaf) is built once from the tree's
+static structure, so ``pack``/``unpack``/``view`` are pure jit-safe
+functions: an optimizer can keep its state (and fp32 masters) *as
+buckets* across steps, an overflow check is one ``isfinite``+reduce per
+bucket, a gradient all-reduce is one ``psum`` per bucket, and LAMB's
+per-tensor trust ratios come from one segment-reduction per bucket over
+the index map.
+
+Design points:
+
+* **Exact dtype preservation.**  Buckets are keyed by dtype, so a
+  ``pack``/``unpack`` round trip is the identity (bitwise) — no silent
+  upcasting of bf16 leaves into an fp32 pool.
+* **Donation friendliness.**  :class:`Packed` is a plain pytree of a
+  few large arrays; donating it at a jit boundary aliases whole buckets
+  in place, exactly like the reference's in-place multi-tensor kernels.
+* **Non-float passthrough.**  Integer/bool/other leaves travel in
+  ``Packed.rest`` untouched, so any params-shaped tree packs.
+* **Static index map.**  Only ``.shape``/``.dtype`` are read at build
+  time — a :class:`BucketStore` can be constructed from concrete
+  arrays, tracers, or ``jax.ShapeDtypeStruct`` templates alike.
+
+A ``BucketStore`` instance is hashable by identity, so it can ride
+through ``jax.jit`` as a static argument; the jitted ``pack_jit``/
+``unpack_jit`` conveniences cache one compiled program per store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BucketStore", "Packed", "cached_store"]
+
+
+def cached_store(cell: dict, template, **kwargs) -> "BucketStore":
+    """Memoized :class:`BucketStore` construction: one store per
+    (tree structure, shapes, dtypes) signature, cached in the caller's
+    ``cell`` dict.  Lazy-store callers (``training.adam(bucketed=True)``,
+    ``zero1(bucketed=True)``) share this so a reused optimizer object —
+    two models, or a resized one — never packs against a stale index
+    map.  ``kwargs`` (e.g. ``decay_mask``) participate in construction
+    but not the key: pass a fresh ``cell`` per configuration."""
+    key = (jax.tree_util.tree_structure(template),
+           tuple((tuple(jnp.shape(l)), str(getattr(l, "dtype", "-")))
+                 for l in jax.tree_util.tree_leaves(template)))
+    store = cell.get(key)
+    if store is None:
+        store = cell[key] = BucketStore(template, **kwargs)
+    return store
+
+
+class Packed(NamedTuple):
+    """A pytree packed by a :class:`BucketStore`.
+
+    ``data`` holds one 1-D array per bucket (the store's bucket order);
+    ``rest`` holds the non-float leaves in their flattened-tree order.
+    A ``Packed`` is itself a pytree, so it jits, donates, scans and
+    ``device_get``/``tree_map``-s like any other carry.
+    """
+    data: Tuple[Any, ...]
+    rest: Tuple[Any, ...]
+
+
+class _Bucket(NamedTuple):
+    """Static index map of one bucket (never traced)."""
+    dtype: Any                       # numpy dtype of the bucket buffer
+    decay: bool                      # weight-decay flag for this bucket
+    leaf_ids: Tuple[int, ...]        # indices into the float-leaf list
+    offsets: Tuple[int, ...]         # element offset of each leaf segment
+    sizes: Tuple[int, ...]           # element count of each leaf segment
+    shapes: Tuple[Tuple[int, ...], ...]
+    size: int                        # total elements in the bucket
+
+
+def _leaf_dtype(x):
+    dt = getattr(x, "dtype", None)
+    return None if dt is None else jnp.dtype(dt)
+
+
+def _is_float_leaf(x) -> bool:
+    dt = _leaf_dtype(x)
+    return dt is not None and jnp.issubdtype(dt, jnp.floating)
+
+
+class BucketStore:
+    """Static index map packing a pytree's float leaves into per-(dtype,
+    decay) 1-D buckets.
+
+    ``decay_mask`` (optional) is a pytree of Python bools matching
+    ``template``: leaves marked ``False`` land in separate no-decay
+    buckets, so a bucketed optimizer applies weight decay as a
+    per-bucket compile-time constant instead of a per-leaf branch.
+    Without a mask every bucket carries ``decay=True`` (decay applies
+    wherever the optimizer's ``weight_decay`` says, matching the
+    leafwise behavior).
+    """
+
+    def __init__(self, template, *, decay_mask=None):
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        self.treedef = treedef
+        self.n_leaves = len(leaves)
+        if decay_mask is None:
+            mask = [True] * len(leaves)
+        else:
+            mask = jax.tree_util.tree_leaves(decay_mask)
+            if len(mask) != len(leaves):
+                raise ValueError(
+                    f"decay_mask has {len(mask)} leaves, template has "
+                    f"{len(leaves)}")
+            mask = [bool(m) for m in mask]
+
+        # float_slot[i] = (bucket_id, segment index within bucket) for
+        # flat leaf i; None marks a passthrough (non-float) leaf.
+        self._slots: list = [None] * len(leaves)
+        order: dict = {}                        # key -> bucket build dict
+        self._rest_ids: list = []
+        for i, leaf in enumerate(leaves):
+            if not _is_float_leaf(leaf):
+                self._slots[i] = ("rest", len(self._rest_ids))
+                self._rest_ids.append(i)
+                continue
+            shape = tuple(int(s) for s in jnp.shape(leaf))
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            key = (jnp.dtype(leaf.dtype), mask[i])
+            b = order.setdefault(key, dict(leaf_ids=[], offsets=[],
+                                           sizes=[], shapes=[], total=0))
+            b["leaf_ids"].append(i)
+            b["offsets"].append(b["total"])
+            b["sizes"].append(size)
+            b["shapes"].append(shape)
+            b["total"] += size
+        self.buckets: Tuple[_Bucket, ...] = tuple(
+            _Bucket(dtype=key[0], decay=key[1],
+                    leaf_ids=tuple(b["leaf_ids"]),
+                    offsets=tuple(b["offsets"]),
+                    sizes=tuple(b["sizes"]),
+                    shapes=tuple(b["shapes"]),
+                    size=b["total"])
+            for key, b in order.items())
+        # final slot map: leaf index -> ("bucket", bucket_id, seg) or
+        # ("rest", rest_pos)
+        for bi, b in enumerate(self.buckets):
+            for seg, leaf_id in enumerate(b.leaf_ids):
+                self._slots[leaf_id] = ("bucket", bi, seg)
+        self._jit_cache: dict = {}
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def decay_flags(self) -> Tuple[bool, ...]:
+        return tuple(b.decay for b in self.buckets)
+
+    @property
+    def dtypes(self) -> Tuple[Any, ...]:
+        return tuple(b.dtype for b in self.buckets)
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(b.size for b in self.buckets)
+
+    def __repr__(self):
+        segs = ", ".join(
+            f"{b.dtype.name}{'[wd]' if b.decay else '[nowd]'}x"
+            f"{len(b.leaf_ids)}={b.size}" for b in self.buckets)
+        return (f"BucketStore({self.n_leaves} leaves -> "
+                f"{self.n_buckets} bucket(s): {segs})")
+
+    # -- pack / unpack / view ------------------------------------------------
+    def _check_tree(self, tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if treedef != self.treedef:
+            raise ValueError(
+                f"tree structure does not match this BucketStore's "
+                f"template:\n  got      {treedef}\n  expected "
+                f"{self.treedef}")
+        return leaves
+
+    def pack(self, tree, *, dtype=None, cast: bool = False) -> Packed:
+        """Pack ``tree`` (template-structured) into bucket buffers.
+
+        ``dtype``: cast every bucket to this dtype (e.g. ``float32``
+        when packing model-dtype grads into fp32 master-grad buckets).
+        ``cast=True``: cast each segment to its *bucket's* dtype (e.g.
+        repacking fp32 values into a bf16-keyed store).  With neither,
+        leaf dtypes must match their bucket dtype exactly — a silent
+        upcast is never performed.
+        """
+        leaves = self._check_tree(tree)
+        data = []
+        for b in self.buckets:
+            out_dt = jnp.dtype(dtype) if dtype is not None else b.dtype
+            segs = []
+            for seg, leaf_id in enumerate(b.leaf_ids):
+                leaf = leaves[leaf_id]
+                ldt = _leaf_dtype(leaf)
+                if dtype is None and not cast and ldt != b.dtype:
+                    raise ValueError(
+                        f"leaf {leaf_id} has dtype {ldt}, bucket expects "
+                        f"{b.dtype}; pass dtype=... or cast=True to cast "
+                        f"explicitly")
+                if tuple(int(s) for s in jnp.shape(leaf)) != b.shapes[seg]:
+                    raise ValueError(
+                        f"leaf {leaf_id} has shape {jnp.shape(leaf)}, "
+                        f"bucket segment expects {b.shapes[seg]} — build "
+                        f"the BucketStore from a same-shaped template")
+                segs.append(jnp.ravel(jnp.asarray(leaf, out_dt)))
+            data.append(segs[0] if len(segs) == 1
+                        else jnp.concatenate(segs))
+        rest = tuple(leaves[i] for i in self._rest_ids)
+        return Packed(data=tuple(data), rest=rest)
+
+    def unpack(self, packed: Packed, *, cast: bool = False):
+        """Rebuild the template-structured pytree from ``packed``.
+
+        ``cast=True`` casts each bucket to its store dtype first (one op
+        per bucket) — the bucket-level master->model copy.  Otherwise
+        leaves come out in the bucket buffer's dtype (the exact packed
+        dtype round-trips bitwise).
+        """
+        if len(packed.data) != self.n_buckets:
+            raise ValueError(f"Packed has {len(packed.data)} buckets, "
+                             f"store has {self.n_buckets}")
+        if len(packed.rest) != len(self._rest_ids):
+            raise ValueError(f"Packed has {len(packed.rest)} passthrough "
+                             f"leaves, store has {len(self._rest_ids)}")
+        leaves: list = [None] * self.n_leaves
+        for b, buf in zip(self.buckets, packed.data):
+            if cast:
+                buf = jnp.asarray(buf, b.dtype)
+            for off, size, shape, leaf_id in zip(b.offsets, b.sizes,
+                                                 b.shapes, b.leaf_ids):
+                leaves[leaf_id] = jax.lax.slice_in_dim(
+                    buf, off, off + size).reshape(shape)
+        for pos, leaf_id in enumerate(self._rest_ids):
+            leaves[leaf_id] = packed.rest[pos]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def view(self, packed: Packed, leaf_index: int):
+        """One leaf of ``packed`` (flattened-tree index), reshaped; a
+        static slice, so it folds into the surrounding program."""
+        slot = self._slots[leaf_index]
+        if slot[0] == "rest":
+            return packed.rest[slot[1]]
+        _, bi, seg = slot
+        b = self.buckets[bi]
+        return jax.lax.slice_in_dim(
+            packed.data[bi], b.offsets[seg],
+            b.offsets[seg] + b.sizes[seg]).reshape(b.shapes[seg])
+
+    def zeros(self, dtype=jnp.float32) -> Packed:
+        """Zero buckets with this store's segmentation (optimizer moment
+        init); ``rest`` is empty — moment trees have no passthrough."""
+        return Packed(
+            data=tuple(jnp.zeros((b.size,), dtype) for b in self.buckets),
+            rest=())
+
+    # -- segment reductions (per-tensor norms over the index map) ------------
+    def segment_ids(self, bucket_index: int):
+        """int32 [size] array mapping each bucket element to its segment
+        (local leaf position).  Generated on device at trace time (an
+        iota+repeat, fused by XLA) — never materialized host-side."""
+        b = self.buckets[bucket_index]
+        return jnp.repeat(jnp.arange(len(b.leaf_ids), dtype=jnp.int32),
+                          jnp.asarray(b.sizes, jnp.int32),
+                          total_repeat_length=b.size)
+
+    def per_leaf_sq_sums(self, data: Sequence[Any]) -> Tuple[Any, ...]:
+        """Per-leaf sum-of-squares, one fp32 ``[n_leaves_in_bucket]``
+        array per bucket — ONE segment reduction per bucket instead of
+        one reduction per leaf (LAMB trust ratios, NovoGrad norms)."""
+        out = []
+        for bi, buf in enumerate(data):
+            b = self.buckets[bi]
+            x = jnp.asarray(buf, jnp.float32)
+            out.append(jax.ops.segment_sum(
+                jnp.square(x), self.segment_ids(bi),
+                num_segments=len(b.leaf_ids)))
+        return tuple(out)
+
+    def per_leaf_max_abs(self, data: Sequence[Any]) -> Tuple[Any, ...]:
+        """Per-leaf max-|x| per bucket (NovoGrad's inf-norm mode)."""
+        out = []
+        for bi, buf in enumerate(data):
+            b = self.buckets[bi]
+            x = jnp.abs(jnp.asarray(buf, jnp.float32))
+            out.append(jax.ops.segment_max(
+                x, self.segment_ids(bi), num_segments=len(b.leaf_ids)))
+        return tuple(out)
+
+    def spread(self, bucket_index: int, per_leaf_vals):
+        """Broadcast a ``[n_leaves_in_bucket]`` vector back to bucket
+        elements (``take`` over the segment map) — turns per-tensor
+        scalars (trust ratios, norm denominators) into elementwise
+        multipliers in one gather."""
+        return jnp.take(per_leaf_vals, self.segment_ids(bucket_index))
+
+    def leaf_order(self) -> Tuple[int, ...]:
+        """Float-leaf indices in flattened-tree order — for reassembling
+        per-leaf results (e.g. per-tensor norms) in the leafwise order
+        the multi_tensor API documents."""
+        return tuple(i for i, s in enumerate(self._slots)
+                     if s[0] == "bucket")
+
+    # -- cached jitted conveniences ------------------------------------------
+    def pack_jit(self, tree, *, dtype=None, cast: bool = False) -> Packed:
+        """``pack`` as ONE cached compiled program (for eager callers:
+        packing a ~800-leaf tree op-by-op would cost ~800 dispatches)."""
+        key = ("pack", None if dtype is None else jnp.dtype(dtype), cast)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda t: self.pack(t, dtype=dtype, cast=cast))
+            self._jit_cache[key] = fn
+        return fn(tree)
+
+    def unpack_jit(self, packed: Packed, *, cast: bool = False):
+        """``unpack`` as ONE cached compiled program."""
+        key = ("unpack", cast)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda p: self.unpack(p, cast=cast))
+            self._jit_cache[key] = fn
+        return fn(packed)

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from ..amp import policy as _policy
 from ..amp._amp_state import maybe_print
+from ..multi_tensor.buckets import BucketStore, Packed
 
 
 def _is_group_list(params) -> bool:
@@ -51,14 +52,28 @@ class FusedOptimizer:
     """Base: subclasses define ``_init_state(params, group)`` and ``_update``
     (a pure function ``(grads, state, params, group, lr, grad_scale,
     apply_mask) -> (params, state)`` reading static hyperparameters from
-    ``group``)."""
+    ``group``).
 
-    def __init__(self, params, defaults: Dict[str, Any]):
+    ``bucketed=True`` (ISSUE 4) switches each group onto the flat-bucket
+    engine: optimizer state — and, when amp-wired, the fp32 masters —
+    live as a few large per-dtype :class:`Packed` buffers *across* steps,
+    so the jitted update's argument list and its HLO op count are
+    O(buckets) instead of O(leaves).  The user-facing ``params`` /
+    ``master_params`` surfaces still speak pytrees (unpacked in one
+    compiled program when read).
+    """
+
+    def __init__(self, params, defaults: Dict[str, Any], *,
+                 bucketed: bool = False):
         self.defaults = dict(defaults)
+        self.bucketed = bool(bucketed)
         self._grouped = _is_group_list(params)
         raw_groups = list(params) if self._grouped else [{"params": params}]
         self.param_groups: List[Dict[str, Any]] = [
             dict(self.defaults, **g) for g in raw_groups]
+        if self.bucketed:
+            for g in self.param_groups:
+                g["_store"] = BucketStore(g["params"])
         self._masters = None           # list of fp32 masters when amp-wired
         self.state = [self._init_state(g["params"], g)
                       for g in self.param_groups]
@@ -99,12 +114,37 @@ class FusedOptimizer:
     @property
     def master_params(self):
         """fp32 masters in the user-facing structure (None unless
-        amp-wired with master weights)."""
-        return None if self._masters is None else self._from_groups(self._masters)
+        amp-wired with master weights).  Bucket-resident masters are
+        unpacked here (one compiled program per store)."""
+        if self._masters is None:
+            return None
+        return self._from_groups([
+            g["_store"].unpack_jit(m) if isinstance(m, Packed) else m
+            for m, g in zip(self._masters, self.param_groups)])
 
     @master_params.setter
     def master_params(self, value):
-        self._masters = None if value is None else self._to_groups(value)
+        if value is None:
+            self._masters = None
+            return
+        groups = self._to_groups(value)
+        if self.bucketed:
+            groups = [m if isinstance(m, Packed)
+                      else g["_store"].pack_jit(m, dtype=jnp.float32)
+                      for m, g in zip(groups, self.param_groups)]
+        self._masters = groups
+
+    def _masters_to_model(self):
+        """master -> model copy for every group (reference
+        ``_process_optimizer.py:345-356``); bucket-resident masters cast
+        at the *bucket* level (one astype per bucket) before unpacking."""
+        model = []
+        for mp, g in zip(self._masters, self.param_groups):
+            if isinstance(mp, Packed):
+                model.append(g["_store"].unpack_jit(mp, cast=True))
+            else:
+                model.append(_policy.master_to_model(mp, g["params"]))
+        return model
 
     def _group_lrs(self):
         return [jnp.float32(g.get("lr", self.defaults.get("lr", 0.0)))
@@ -165,9 +205,13 @@ class FusedOptimizer:
                 g["params"] = _policy.convert_params(
                     g["params"], cast_type, keep_norm_fp32=keep_bn,
                     norm_predicate=getattr(self, "_norm_predicate", None))
+        if self.bucketed:
+            g["_store"] = BucketStore(g["params"])
         self.param_groups.append(g)
         if self._masters is not None:
             master = _policy.make_master(g["params"])
+            if self.bucketed:
+                master = g["_store"].pack_jit(master, dtype=jnp.float32)
             self._masters = list(self._masters) + [master]
             self.state.append(self._init_state(master, g))
         else:
@@ -301,11 +345,33 @@ class FusedOptimizer:
             model_params = (cast_params if cast_params is not None
                             else self.params)
             model_groups = self._to_groups(model_params)
+        if self.bucketed:
+            # The model params were just cast: rebuild each group's store
+            # so bucket dtypes key on the MODEL dtypes (the unpack-with-
+            # cast master->model copy then reproduces keep-norm-fp32
+            # leaves exactly).
+            for g, mp in zip(self.param_groups, model_groups):
+                g["_store"] = BucketStore(mp)
+            self._jit_update = None
+            if not properties.master_weights:
+                # No-master levels (O3): the update target IS the cast
+                # model params — the Packed state built pre-cast carries
+                # the stale segmentation, so rebuild it on the new
+                # stores (state is still zero at initialize time, same
+                # as the master-weights re-init below).
+                self.state = [self._init_state(mp, g) for mp, g in
+                              zip(model_groups, self.param_groups)]
         if properties.master_weights:
             # fp32 masters are the update target (reference
             # _process_optimizer.py:28-90: masters swapped into param_groups).
             self._masters = [_policy.make_master(mp)
                              for mp in model_groups]
+            if self.bucketed:
+                # Masters live AS fp32 buckets across steps: the jitted
+                # update's carry is a few large buffers, not O(leaves).
+                self._masters = [
+                    g["_store"].pack_jit(m, dtype=jnp.float32)
+                    for m, g in zip(self._masters, self.param_groups)]
             self.state = [self._init_state(mp, g) for mp, g in
                           zip(self._masters, self.param_groups)]
             self._jit_update = None
@@ -325,7 +391,26 @@ class FusedOptimizer:
         if self._pending_grads is None:
             return
         if self._stashed_grads is None:
-            self._master_grads, _ = loss_scaler.unscale(self._pending_grads)
+            if self.bucketed and not self._grouped:
+                # Pack the scaled model-dtype grads and unscale on the
+                # buckets: the fp32 master grads then enter step() as a
+                # few large buffers (one overflow reduce per bucket).
+                store = self.param_groups[0]["_store"]
+                packed = store.pack_jit(self._pending_grads)
+                self._master_grads, _ = loss_scaler.unscale(packed,
+                                                            store=store)
+            else:
+                self._master_grads, _ = loss_scaler.unscale(
+                    self._pending_grads)
+        elif isinstance(self._stashed_grads, Packed):
+            # Accumulation onto a bucket-resident stash: pack the new
+            # scaled grads and run the fused axpby per bucket (mixing a
+            # Packed stash with a pytree would fail in tree_map).
+            store = self.param_groups[0]["_store"]
+            packed = store.pack_jit(self._pending_grads)
+            self._master_grads, _ = loss_scaler.unscale_with_stashed(
+                packed, self._stashed_grads, store=store)
+            self._stashed_grads = None
         else:
             self._master_grads, _ = loss_scaler.unscale_with_stashed(
                 self._pending_grads, self._stashed_grads)
@@ -394,9 +479,7 @@ class FusedOptimizer:
         if self._masters is not None:
             self._masters = new_params
             # master -> model copy (reference _process_optimizer.py:345-356).
-            model = [_policy.master_to_model(mp, g["params"]) for mp, g in
-                     zip(new_params, self.param_groups)]
-            self._set_group_params(model)
+            self._set_group_params(self._masters_to_model())
         else:
             self._set_group_params(new_params)
         self._master_grads = None
@@ -419,7 +502,12 @@ class FusedOptimizer:
                    for g in self.param_groups],
         }
         if self._masters is not None:
-            sd["master_params"] = jax.device_get(self._masters)
+            # Serialize masters in the user-facing pytree form so a
+            # bucketed checkpoint loads into a leafwise optimizer and
+            # vice versa (optimizer *state* stays mode-specific).
+            sd["master_params"] = jax.device_get([
+                g["_store"].unpack_jit(m) if isinstance(m, Packed) else m
+                for m, g in zip(self._masters, self.param_groups)])
         return sd
 
     def load_state_dict(self, sd):
@@ -437,8 +525,13 @@ class FusedOptimizer:
             masters = sd["master_params"]
             if not isinstance(masters, list):
                 masters = [masters]
-            self._masters = [jax.tree_util.tree_map(jnp.asarray, m)
-                             for m in masters]
-            model = [_policy.master_to_model(mp, g["params"]) for mp, g in
-                     zip(self._masters, self.param_groups)]
-            self._set_group_params(model)
+            masters = [jax.tree_util.tree_map(jnp.asarray, m)
+                       for m in masters]
+            if self.bucketed:
+                # Checkpoints store masters in the user-facing pytree
+                # form (see state_dict); re-pack bucket-resident masters.
+                masters = [m if isinstance(m, Packed)
+                           else g["_store"].pack_jit(m, dtype=jnp.float32)
+                           for m, g in zip(masters, self.param_groups)]
+            self._masters = masters
+            self._set_group_params(self._masters_to_model())

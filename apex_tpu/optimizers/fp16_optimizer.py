@@ -30,6 +30,14 @@ class FP16_Optimizer:
         self.fp16_params = init_optimizer.params
         self.fp32_masters = _policy.make_master(self.fp16_params)
         init_optimizer.params = self.fp32_masters
+        if getattr(init_optimizer, "bucketed", False):
+            # The update target just changed dtype (reduced-precision
+            # model params -> fp32 masters): rebuild each group's bucket
+            # store so bucket dtypes key on what step() actually packs.
+            from ..multi_tensor.buckets import BucketStore
+            for g in init_optimizer.param_groups:
+                g["_store"] = BucketStore(g["params"])
+            init_optimizer._jit_update = None
         init_optimizer.state = [
             init_optimizer._init_state(p, g) for p, g in
             zip(init_optimizer._to_groups(self.fp32_masters),

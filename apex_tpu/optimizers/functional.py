@@ -13,20 +13,53 @@ of the reference's tensor lists — computes per-leaf updates, and unflattens.
 Each function is shaped like an optax update: ``(grads, state, params) ->
 (new_params, new_state)``, jit/vmap/shard_map-safe, no Python control flow on
 traced values.
+
+**Bucketed mode** (ISSUE 4): pass ``store=BucketStore(params)`` and every
+update runs over a few large per-dtype flat buffers instead of one subgraph
+per leaf — O(buckets) HLO ops and jit arguments for deep pytrees.  The
+optimizer state is then held as :class:`~apex_tpu.multi_tensor.buckets.
+Packed` buckets (a valid scan carry / donation target); ``params`` and
+``grads`` may be pytrees (packed/unpacked inside the program) or already-
+``Packed`` values (kept packed, for callers that hold masters as buckets
+across steps).  The elementwise math is performed in the identical order
+per element, so the fp32 bucketed Adam/SGD trajectories are **bitwise**
+equal to the leafwise ones; LAMB/NovoGrad per-tensor norms use segment
+reductions whose accumulation order differs harmlessly (allclose).
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..multi_tensor import multi_tensor_l2norm
+from ..multi_tensor.buckets import BucketStore, Packed
 
 
 def _f32(x):
     return jnp.asarray(x, jnp.float32)
+
+
+def _pack_args(store: BucketStore, grads, params):
+    """Route (grads, params) through ``store``: returns fp32 grad buckets,
+    param buckets (native dtype), and whether params arrived Packed (the
+    caller then gets Packed params back)."""
+    was_packed = isinstance(params, Packed)
+    p_in = params if was_packed else store.pack(params)
+    # Grads are consumed in fp32 whatever their storage dtype (the
+    # leafwise ``_f32(g)`` cast) — pack them straight into fp32 buckets.
+    g_in = (grads if isinstance(grads, Packed)
+            else store.pack(grads, dtype=jnp.float32))
+    return g_in, p_in, was_packed
+
+
+def _bucket_masked(mask, new_data, old_packed: Packed) -> tuple:
+    if mask is None:
+        return tuple(new_data)
+    return tuple(jnp.where(mask, n, jnp.asarray(o, n.dtype))
+                 for n, o in zip(new_data, old_packed.data))
 
 
 def _flatten(params, *other_trees):
@@ -56,20 +89,71 @@ class AdamState(NamedTuple):
     exp_avg_sq: Any
 
 
-def adam_init(params) -> AdamState:
+def adam_init(params, *, store: Optional[BucketStore] = None) -> AdamState:
+    if store is not None:
+        return AdamState(step=jnp.int32(0), exp_avg=store.zeros(),
+                         exp_avg_sq=store.zeros())
     z = lambda: jax.tree_util.tree_map(
         lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
     return AdamState(step=jnp.int32(0), exp_avg=z(), exp_avg_sq=z())
 
 
+def _bucket_adam_update(grads, state, params, *, store, lr, beta1, beta2,
+                        eps, weight_decay, adam_w_mode, bias_correction,
+                        grad_scale, apply_mask):
+    """O(buckets) Adam: one fused elementwise sweep per (dtype, decay)
+    bucket; bitwise-equal per element to the leafwise path."""
+    step = _count_step(state.step, apply_mask)
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** _f32(step)
+        bc2 = 1.0 - beta2 ** _f32(step)
+    else:
+        bc1 = bc2 = 1.0
+    g_in, p_in, was_packed = _pack_args(store, grads, params)
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v, decay in zip(g_in.data, p_in.data, state.exp_avg.data,
+                                 state.exp_avg_sq.data, store.decay_flags):
+        wd = weight_decay if decay else 0.0
+        g = jnp.asarray(g, jnp.float32) / grad_scale
+        p32 = _f32(p)
+        if not adam_w_mode and wd != 0.0:
+            g = g + wd * p32
+        m_n = beta1 * m + (1.0 - beta1) * g
+        v_n = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+        if adam_w_mode and wd != 0.0:
+            update = update + wd * p32
+        new_p.append((p32 - lr * update).astype(p.dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+    out = Packed(data=_bucket_masked(apply_mask, new_p, p_in),
+                 rest=p_in.rest)
+    return (out if was_packed else store.unpack(out),
+            AdamState(step=step,
+                      exp_avg=Packed(_bucket_masked(apply_mask, new_m,
+                                                    state.exp_avg), ()),
+                      exp_avg_sq=Packed(_bucket_masked(apply_mask, new_v,
+                                                       state.exp_avg_sq),
+                                        ())))
+
+
 def adam_update(grads, state: AdamState, params, *,
                 lr, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
                 adam_w_mode=True, bias_correction=True, grad_scale=1.0,
-                apply_mask=None):
+                apply_mask=None, store: Optional[BucketStore] = None):
     """Fused Adam/AdamW (reference ``csrc/multi_tensor_adam.cu:23-127``:
     ADAM_MODE_0 = L2 regularization, ADAM_MODE_1 = decoupled AdamW; host-side
     bias corrections ``:131-171``).  fp32 math; params may be any float dtype.
+
+    ``store`` switches to the O(buckets) flat-buffer path (state held as
+    ``Packed`` buckets, created by ``adam_init(params, store=store)``).
     """
+    if store is not None:
+        return _bucket_adam_update(
+            grads, state, params, store=store, lr=lr, beta1=beta1,
+            beta2=beta2, eps=eps, weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode, bias_correction=bias_correction,
+            grad_scale=grad_scale, apply_mask=apply_mask)
     step = _count_step(state.step, apply_mask)
     if bias_correction:
         bc1 = 1.0 - beta1 ** _f32(step)
@@ -109,21 +193,67 @@ class SGDState(NamedTuple):
     initialized: jnp.ndarray
 
 
-def sgd_init(params, momentum=0.0) -> SGDState:
+def sgd_init(params, momentum=0.0, *,
+             store: Optional[BucketStore] = None) -> SGDState:
+    if store is not None:
+        return SGDState(momentum_buf=store.zeros(),
+                        initialized=jnp.asarray(False))
     buf = jax.tree_util.tree_map(
         lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
     return SGDState(momentum_buf=buf, initialized=jnp.asarray(False))
 
 
+def _bucket_sgd_update(grads, state, params, *, store, lr, momentum,
+                       dampening, nesterov, weight_decay, wd_after_momentum,
+                       grad_scale, apply_mask):
+    first_run = jnp.logical_not(state.initialized)
+    g_in, p_in, was_packed = _pack_args(store, grads, params)
+    new_p, new_m = [], []
+    for g, p, m, decay in zip(g_in.data, p_in.data, state.momentum_buf.data,
+                              store.decay_flags):
+        wd = weight_decay if decay else 0.0
+        g = jnp.asarray(g, jnp.float32) / grad_scale
+        p32 = _f32(p)
+        if wd != 0.0 and not wd_after_momentum:
+            g = g + wd * p32
+        if momentum != 0.0:
+            m_n = jnp.where(first_run, g, momentum * m + (1.0 - dampening) * g)
+            d = g + momentum * m_n if nesterov else m_n
+        else:
+            m_n = m
+            d = g
+        if wd != 0.0 and wd_after_momentum:
+            d = d + wd * p32
+        new_p.append((p32 - lr * d).astype(p.dtype))
+        new_m.append(m_n)
+    out = Packed(data=_bucket_masked(apply_mask, new_p, p_in),
+                 rest=p_in.rest)
+    initialized = jnp.logical_or(
+        state.initialized,
+        jnp.asarray(True) if apply_mask is None else apply_mask)
+    return (out if was_packed else store.unpack(out),
+            SGDState(momentum_buf=Packed(
+                         _bucket_masked(apply_mask, new_m,
+                                        state.momentum_buf), ()),
+                     initialized=initialized))
+
+
 def sgd_update(grads, state: SGDState, params, *,
                lr, momentum=0.0, dampening=0.0, nesterov=False,
                weight_decay=0.0, wd_after_momentum=False, grad_scale=1.0,
-               apply_mask=None):
+               apply_mask=None, store: Optional[BucketStore] = None):
     """Fused SGD (reference ``csrc/multi_tensor_sgd_kernel.cu:141-278``):
     weight decay, momentum, dampening, nesterov, ``first_run`` momentum
     initialization, ``wd_after_momentum`` and fused ``1/scale`` grad scaling,
-    all inside the single compiled update.
+    all inside the single compiled update.  ``store`` routes the sweep
+    through O(buckets) flat buffers.
     """
+    if store is not None:
+        return _bucket_sgd_update(
+            grads, state, params, store=store, lr=lr, momentum=momentum,
+            dampening=dampening, nesterov=nesterov,
+            weight_decay=weight_decay, wd_after_momentum=wd_after_momentum,
+            grad_scale=grad_scale, apply_mask=apply_mask)
     first_run = jnp.logical_not(state.initialized)
 
     treedef, ps, (gs, ms) = _flatten(params, grads, state.momentum_buf)
@@ -162,24 +292,106 @@ class LambState(NamedTuple):
     exp_avg_sq: Any
 
 
-def lamb_init(params) -> LambState:
+def lamb_init(params, *, store: Optional[BucketStore] = None) -> LambState:
+    if store is not None:
+        return LambState(step=jnp.int32(0), exp_avg=store.zeros(),
+                         exp_avg_sq=store.zeros())
     z = lambda: jax.tree_util.tree_map(
         lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
     return LambState(step=jnp.int32(0), exp_avg=z(), exp_avg_sq=z())
+
+
+def _bucket_lamb_update(grads, state, params, *, store, lr, beta1, beta2,
+                        eps, weight_decay, adam_w_mode, bias_correction,
+                        grad_averaging, max_grad_norm, use_nvlamb,
+                        grad_scale, apply_mask):
+    """O(buckets) LAMB: stage 1 (global clip + moment EMAs + update
+    vector) is one elementwise sweep per bucket; stage 2's per-tensor
+    trust ratios come from ONE segment reduction per bucket over the
+    index map instead of two reductions per leaf."""
+    step = _count_step(state.step, apply_mask)
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** _f32(step)
+        bc2 = 1.0 - beta2 ** _f32(step)
+    else:
+        bc1 = bc2 = 1.0
+
+    g_in, p_in, was_packed = _pack_args(store, grads, params)
+    gs = [jnp.asarray(g, jnp.float32) / grad_scale for g in g_in.data]
+    # Global gradient norm for clipping: one reduction per bucket.
+    gnorm = jnp.sqrt(jnp.sum(jnp.stack(
+        [jnp.sum(jnp.square(g)) for g in gs])))
+    if max_grad_norm is not None and max_grad_norm > 0:
+        clip = jnp.where(gnorm > max_grad_norm, gnorm / max_grad_norm, 1.0)
+    else:
+        clip = 1.0
+
+    # Stage 1: moments + Adam-style update vector, per bucket.
+    p32s, ups, new_m, new_v = [], [], [], []
+    for g, p, m, v, decay in zip(gs, p_in.data, state.exp_avg.data,
+                                 state.exp_avg_sq.data, store.decay_flags):
+        wd = weight_decay if decay else 0.0
+        g = g / clip
+        p32 = _f32(p)
+        m_n = beta1 * m + beta3 * g
+        v_n = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        update = (m_n / bc1) / (jnp.sqrt(v_n / bc2) + eps)
+        if wd != 0.0:
+            update = update + wd * p32
+        p32s.append(p32)
+        ups.append(update)
+        new_m.append(m_n)
+        new_v.append(v_n)
+
+    # Stage 2: per-tensor trust ratios via segment reductions.
+    p_sq = store.per_leaf_sq_sums(p32s)
+    u_sq = store.per_leaf_sq_sums(ups)
+    new_p = []
+    for bi, (p, p32, update) in enumerate(zip(p_in.data, p32s, ups)):
+        p_norm = jnp.sqrt(p_sq[bi])
+        u_norm = jnp.sqrt(u_sq[bi])
+        if use_nvlamb:
+            ratio = jnp.where(u_norm > 0, p_norm / u_norm, 1.0)
+        else:
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                              p_norm / u_norm, 1.0)
+        ratio_e = store.spread(bi, ratio)
+        new_p.append((p32 - lr * ratio_e * update).astype(p.dtype))
+
+    out = Packed(data=_bucket_masked(apply_mask, new_p, p_in),
+                 rest=p_in.rest)
+    return (out if was_packed else store.unpack(out),
+            LambState(step=step,
+                      exp_avg=Packed(_bucket_masked(apply_mask, new_m,
+                                                    state.exp_avg), ()),
+                      exp_avg_sq=Packed(_bucket_masked(apply_mask, new_v,
+                                                       state.exp_avg_sq),
+                                        ())))
 
 
 def lamb_update(grads, state: LambState, params, *,
                 lr, beta1=0.9, beta2=0.999, eps=1e-6, weight_decay=0.01,
                 adam_w_mode=True, bias_correction=True, grad_averaging=True,
                 max_grad_norm=1.0, use_nvlamb=False, grad_scale=1.0,
-                apply_mask=None):
+                apply_mask=None, store: Optional[BucketStore] = None):
     """Fused LAMB (reference ``csrc/multi_tensor_lamb.cu:29-289``):
 
     stage 1 — global grad-norm clip (l2norm over ALL grads), m/v update,
     per-tensor Adam-style update vector; stage 2 — per-tensor trust ratio
     ``|p| / |update|`` scales the step.  ``use_nvlamb`` applies the trust
-    ratio even when a tensor's param norm is zero.
+    ratio even when a tensor's param norm is zero.  ``store`` routes both
+    stages through O(buckets) flat buffers (trust ratios from segment
+    reductions over the index map).
     """
+    if store is not None:
+        return _bucket_lamb_update(
+            grads, state, params, store=store, lr=lr, beta1=beta1,
+            beta2=beta2, eps=eps, weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode, bias_correction=bias_correction,
+            grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb, grad_scale=grad_scale,
+            apply_mask=apply_mask)
     step = _count_step(state.step, apply_mask)
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
     if bias_correction:
@@ -233,23 +445,99 @@ class NovoGradState(NamedTuple):
     exp_avg_sq: Any        # per-TENSOR scalar second moment (norm, not squared)
 
 
-def novograd_init(params) -> NovoGradState:
+def novograd_init(params, *,
+                  store: Optional[BucketStore] = None) -> NovoGradState:
+    if store is not None:
+        # exp_avg_sq: one scalar per tensor — [n_leaves_in_bucket] arrays
+        # carried in a Packed container (never unpacked to the tree).
+        return NovoGradState(
+            step=jnp.int32(0), exp_avg=store.zeros(),
+            exp_avg_sq=Packed(
+                data=tuple(jnp.zeros((len(b.leaf_ids),), jnp.float32)
+                           for b in store.buckets),
+                rest=()))
     zeros = jax.tree_util.tree_map(
         lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
     scalars = jax.tree_util.tree_map(lambda p: jnp.float32(0), params)
     return NovoGradState(step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=scalars)
 
 
+def _bucket_novograd_update(grads, state, params, *, store, lr, beta1,
+                            beta2, eps, weight_decay, grad_averaging,
+                            norm_type, init_zero, adam_w_mode,
+                            bias_correction, grad_scale, apply_mask):
+    """O(buckets) NovoGrad: per-tensor grad norms via one segment
+    reduction per bucket; the scalar second moments stay as
+    ``[n_leaves_in_bucket]`` vectors."""
+    step = _count_step(state.step, apply_mask)
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** _f32(step)
+        bc2 = 1.0 - beta2 ** _f32(step)
+    else:
+        bc1 = bc2 = 1.0
+    first = step == 1
+
+    g_in, p_in, was_packed = _pack_args(store, grads, params)
+    gs = [jnp.asarray(g, jnp.float32) / grad_scale for g in g_in.data]
+    if norm_type == 2:
+        g_norms = [jnp.sqrt(s) for s in store.per_leaf_sq_sums(gs)]
+    else:
+        g_norms = list(store.per_leaf_max_abs(gs))
+
+    new_p, new_m, new_v = [], [], []
+    for bi, (g, p, m, v, decay) in enumerate(
+            zip(gs, p_in.data, state.exp_avg.data, state.exp_avg_sq.data,
+                store.decay_flags)):
+        wd = weight_decay if decay else 0.0
+        p32 = _f32(p)
+        if init_zero:
+            v_n = beta2 * v + (1.0 - beta2) * g_norms[bi]
+        else:
+            v_n = jnp.where(first, g_norms[bi],
+                            beta2 * v + (1.0 - beta2) * g_norms[bi])
+        denom = v_n / jnp.sqrt(bc2) + eps if bias_correction else v_n + eps
+        scaled_g = g / store.spread(bi, denom)
+        if wd != 0.0 and not adam_w_mode:
+            scaled_g = scaled_g + wd * p32
+        m_n = beta1 * m + beta3 * scaled_g
+        update = m_n / bc1
+        if wd != 0.0 and adam_w_mode:
+            update = update + wd * p32
+        new_p.append((p32 - lr * update).astype(p.dtype))
+        new_m.append(m_n)
+        new_v.append(v_n)
+
+    out = Packed(data=_bucket_masked(apply_mask, new_p, p_in),
+                 rest=p_in.rest)
+    return (out if was_packed else store.unpack(out),
+            NovoGradState(step=step,
+                          exp_avg=Packed(_bucket_masked(apply_mask, new_m,
+                                                        state.exp_avg), ()),
+                          exp_avg_sq=Packed(
+                              _bucket_masked(apply_mask, new_v,
+                                             state.exp_avg_sq), ())))
+
+
 def novograd_update(grads, state: NovoGradState, params, *,
                     lr, beta1=0.95, beta2=0.98, eps=1e-8, weight_decay=0.0,
                     grad_averaging=True, norm_type=2, init_zero=False,
                     adam_w_mode=True, bias_correction=False, grad_scale=1.0,
-                    apply_mask=None):
+                    apply_mask=None, store: Optional[BucketStore] = None):
     """Fused NovoGrad (reference ``csrc/multi_tensor_novograd.cu`` +
     ``apex/optimizers/fused_novograd.py:157-176``): the second moment is ONE
     SCALAR PER TENSOR — an EMA of the per-tensor grad norm.  First step
     initializes it to the grad norm itself (or zero with ``init_zero``).
+    ``store`` routes the norms through per-bucket segment reductions.
     """
+    if store is not None:
+        return _bucket_novograd_update(
+            grads, state, params, store=store, lr=lr, beta1=beta1,
+            beta2=beta2, eps=eps, weight_decay=weight_decay,
+            grad_averaging=grad_averaging, norm_type=norm_type,
+            init_zero=init_zero, adam_w_mode=adam_w_mode,
+            bias_correction=bias_correction, grad_scale=grad_scale,
+            apply_mask=apply_mask)
     step = _count_step(state.step, apply_mask)
     beta3 = 1.0 - beta1 if grad_averaging else 1.0
     if bias_correction:

@@ -13,17 +13,18 @@ from . import functional as F
 class FusedAdam(FusedOptimizer):
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
-                 weight_decay=0.0, amsgrad=False, set_grad_none=True):
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True,
+                 bucketed=False):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad "
                                "variant (reference parity).")
         defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
                         eps=eps, weight_decay=weight_decay,
                         adam_w_mode=adam_w_mode)
-        super().__init__(params, defaults)
+        super().__init__(params, defaults, bucketed=bucketed)
 
     def _init_state(self, params, group=None):
-        return F.adam_init(params)
+        return F.adam_init(params, store=(group or {}).get("_store"))
 
     def _update(self, grads, state, params, *, group, lr, grad_scale,
                 apply_mask):
@@ -33,4 +34,4 @@ class FusedAdam(FusedOptimizer):
             beta1=d["betas"][0], beta2=d["betas"][1], eps=d["eps"],
             weight_decay=d["weight_decay"], adam_w_mode=d["adam_w_mode"],
             bias_correction=d["bias_correction"], grad_scale=grad_scale,
-            apply_mask=apply_mask)
+            apply_mask=apply_mask, store=d.get("_store"))

@@ -14,17 +14,18 @@ class FusedLAMB(FusedOptimizer):
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
                  amsgrad=False, adam_w_mode=True, grad_averaging=True,
-                 set_grad_none=True, max_grad_norm=1.0, use_nvlamb=False):
+                 set_grad_none=True, max_grad_norm=1.0, use_nvlamb=False,
+                 bucketed=False):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
                         eps=eps, weight_decay=weight_decay,
                         adam_w_mode=adam_w_mode, grad_averaging=grad_averaging,
                         max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb)
-        super().__init__(params, defaults)
+        super().__init__(params, defaults, bucketed=bucketed)
 
     def _init_state(self, params, group=None):
-        return F.lamb_init(params)
+        return F.lamb_init(params, store=(group or {}).get("_store"))
 
     def _update(self, grads, state, params, *, group, lr, grad_scale,
                 apply_mask):
@@ -36,4 +37,5 @@ class FusedLAMB(FusedOptimizer):
             bias_correction=d["bias_correction"],
             grad_averaging=d["grad_averaging"],
             max_grad_norm=d["max_grad_norm"], use_nvlamb=d["use_nvlamb"],
-            grad_scale=grad_scale, apply_mask=apply_mask)
+            grad_scale=grad_scale, apply_mask=apply_mask,
+            store=d.get("_store"))

@@ -15,7 +15,8 @@ class FusedNovoGrad(FusedOptimizer):
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
                  amsgrad=False, reg_inside_moment=False, grad_averaging=True,
-                 norm_type=2, init_zero=False, set_grad_none=True):
+                 norm_type=2, init_zero=False, set_grad_none=True,
+                 bucketed=False):
         if amsgrad:
             raise RuntimeError("FusedNovoGrad does not support the AMSGrad "
                                "variant.")
@@ -27,10 +28,10 @@ class FusedNovoGrad(FusedOptimizer):
                         norm_type=2 if norm_type == 2 else 0,
                         init_zero=init_zero,
                         reg_inside_moment=reg_inside_moment)
-        super().__init__(params, defaults)
+        super().__init__(params, defaults, bucketed=bucketed)
 
     def _init_state(self, params, group=None):
-        return F.novograd_init(params)
+        return F.novograd_init(params, store=(group or {}).get("_store"))
 
     def _update(self, grads, state, params, *, group, lr, grad_scale,
                 apply_mask):
@@ -44,4 +45,5 @@ class FusedNovoGrad(FusedOptimizer):
             init_zero=d["init_zero"],
             adam_w_mode=not d["reg_inside_moment"],
             bias_correction=d["bias_correction"],
-            grad_scale=grad_scale, apply_mask=apply_mask)
+            grad_scale=grad_scale, apply_mask=apply_mask,
+            store=d.get("_store"))

@@ -22,7 +22,7 @@ class FusedSGD(FusedOptimizer):
     def __init__(self, params, lr=1e-3, momentum=0.0, dampening=0.0,
                  weight_decay=0.0, nesterov=False,
                  wd_after_momentum=False, materialize_master_grads=True,
-                 set_grad_none=False):
+                 set_grad_none=False, bucketed=False):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero "
                              "dampening")
@@ -34,11 +34,12 @@ class FusedSGD(FusedOptimizer):
         # scale_set_by_backward): lets the update fuse the unscale.
         self.most_recent_scale = 1.0
         self.scale_set_by_backward = False
-        super().__init__(params, defaults)
+        super().__init__(params, defaults, bucketed=bucketed)
 
     def _init_state(self, params, group=None):
         momentum = (group or self.defaults)["momentum"]
-        return F.sgd_init(params, momentum)
+        return F.sgd_init(params, momentum,
+                          store=(group or {}).get("_store"))
 
     def _update(self, grads, state, params, *, group, lr, grad_scale,
                 apply_mask):
@@ -48,7 +49,8 @@ class FusedSGD(FusedOptimizer):
             dampening=d["dampening"], nesterov=d["nesterov"],
             weight_decay=d["weight_decay"],
             wd_after_momentum=d["wd_after_momentum"],
-            grad_scale=grad_scale, apply_mask=apply_mask)
+            grad_scale=grad_scale, apply_mask=apply_mask,
+            store=d.get("_store"))
 
     def _post_amp_backward(self, loss_scaler):
         if not self.materialize_master_grads and self.master_params is not None:
@@ -90,9 +92,7 @@ class FusedSGD(FusedOptimizer):
             new_params, self.state = self._run_update(
                 self._to_groups(self._master_grads), self._masters, scale)
             self._masters = new_params
-            model = [_policy.master_to_model(mp, g["params"]) for mp, g in
-                     zip(new_params, self.param_groups)]
-            self._set_group_params(model)
+            self._set_group_params(self._masters_to_model())
             self._master_grads = None
             self.most_recent_scale = 1.0
             self.scale_set_by_backward = False

@@ -60,10 +60,17 @@ class LARC:
         return self.optim.param_groups
 
     def step(self, grads=None, closure=None):
+        from ..multi_tensor.buckets import Packed
+
         if grads is None:
             grads = self.optim._master_grads or self.optim._pending_grads
-        targets = (self.optim._masters
-                   if self.optim._masters is not None
+        if isinstance(grads, Packed) and not self.optim._grouped:
+            # A bucketed amp optimizer delivers master grads as flat
+            # buckets; LARC's per-tensor rewrite needs the pytree view.
+            grads = self.optim.param_groups[0]["_store"].unpack_jit(grads)
+        masters = self.optim.master_params    # unpacked, user-facing
+        targets = (self.optim._to_groups(masters)
+                   if masters is not None
                    else [g["params"] for g in self.optim.param_groups])
         # Per-group rewrite with the group's own lr and weight decay
         # (reference absorbs/restores wd per group, LARC.py:71-97).

@@ -71,8 +71,8 @@ def import_shard_map():
 
 
 def _is_float(x):
-    return hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype,
-                                                  jnp.floating)
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jnp.floating)
 
 
 def vma_tracking_live(axis_name) -> bool:
@@ -173,7 +173,8 @@ def reduce_gradients(grads,
                      gradient_predivide_factor: float = 1.0,
                      allreduce_always_fp32: bool = False,
                      axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
-                     world_size: Optional[int] = None):
+                     world_size: Optional[int] = None,
+                     bucket_store=None):
     """All-reduce a gradient pytree across ``axis_name`` replicas.
 
     Equivalent of ``allreduce_bucket`` (reference ``distributed.py:425-475``):
@@ -185,6 +186,13 @@ def reduce_gradients(grads,
     the DP contract then spans their product, as when a model is replicated
     over a 2-D data × sequence-parallel mesh.  ``axis_index_groups``
     requires a single axis.
+
+    ``bucket_store`` (a :class:`~apex_tpu.multi_tensor.BucketStore` built
+    from the grad tree) is the apex-DDP flat-bucket path: grads are packed
+    into per-dtype flat buffers and the reduction is ONE ``psum`` per
+    bucket — with ``allreduce_always_fp32`` casting at the bucket level —
+    instead of one collective per leaf.  An already-``Packed`` ``grads``
+    stays packed in the output.
     """
     axis_names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     if len(axis_names) > 1 and axis_index_groups:
@@ -266,6 +274,14 @@ def reduce_gradients(grads,
             g = g.astype(orig_dtype)
         return g
 
+    from ..multi_tensor.buckets import Packed
+    if bucket_store is not None or isinstance(grads, Packed):
+        packed = (grads if isinstance(grads, Packed)
+                  else bucket_store.pack(grads))
+        out = jax.tree_util.tree_map(one, packed)   # one() per BUCKET
+        if isinstance(grads, Packed):
+            return out
+        return bucket_store.unpack(out)
     return jax.tree_util.tree_map(one, grads)
 
 
@@ -313,7 +329,8 @@ class DistributedDataParallel:
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
                  axis_index_groups=None,
-                 prof: bool = False):
+                 prof: bool = False,
+                 bucket_store=None):
         if shared_param is not None:
             raise ValueError("shared_param is deprecated (reference parity: "
                              "distributed.py:149-151); use delay_allreduce.")
@@ -326,6 +343,7 @@ class DistributedDataParallel:
         self.axis_index_groups = axis_index_groups
         self.retain_allreduce_buffers = retain_allreduce_buffers
         self.prof = prof
+        self.bucket_store = bucket_store
         self._disable_allreduce = False
 
     # Forward passes through to the wrapped module (reference module wrapper).
@@ -348,7 +366,8 @@ class DistributedDataParallel:
                 gradient_average=self.gradient_average,
                 gradient_predivide_factor=self.gradient_predivide_factor,
                 allreduce_always_fp32=self.allreduce_always_fp32,
-                axis_index_groups=self.axis_index_groups)
+                axis_index_groups=self.axis_index_groups,
+                bucket_store=self.bucket_store)
 
     @contextlib.contextmanager
     def no_sync(self):

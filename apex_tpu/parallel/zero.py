@@ -17,10 +17,15 @@ memory by the axis size.
 
 The whole-model flat-buffer view reuses the multi-tensor capability
 (SURVEY §2.6: "whole-model single-launch updates"): the parameter pytree
-is raveled into ONE padded fp32 vector, chunked over the axis.  Works
-with elementwise optimizers (adam, sgd); per-tensor-norm optimizers
-(lamb, novograd) need tensor-granular sharding and are rejected — their
-trust ratios are wrong on arbitrary flat chunks.
+is raveled into ONE padded fp32 vector, chunked over the axis.  With
+``bucketed=True`` the ravel goes through a
+:class:`~apex_tpu.multi_tensor.BucketStore` instead — one padded flat
+buffer per parameter *dtype*, each sharded evenly over the axis — which
+lifts the uniform-dtype restriction (mixed fp32/bf16 trees shard
+per-bucket) while keeping O(buckets) collectives.  Works with
+elementwise optimizers (adam, sgd); per-tensor-norm optimizers (lamb,
+novograd) need tensor-granular sharding and are rejected — their trust
+ratios are wrong on arbitrary flat chunks.
 
 Usage (inside shard_map; the state's flat leaves are sharded over the
 axis with ``P(axis)``)::
@@ -63,7 +68,8 @@ def _flatten(tree):
         raise ValueError(
             f"zero1 needs a uniform parameter dtype to build the flat "
             f"buffer; got {sorted(map(str, dtypes))} — under amp O2 the "
-            f"fp32 masters satisfy this")
+            f"fp32 masters satisfy this, or pass bucketed=True to shard "
+            f"per-dtype flat buckets")
     return jnp.concatenate([jnp.ravel(l) for l in leaves])
 
 
@@ -77,7 +83,62 @@ def _unflatten(flat, like):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def zero1(tx, axis_name: str, *, num_shards: int):
+def _gather_replicated(new_local, flat_like, idx, chunk, axis_name):
+    """All-gather a rank's updated chunk back into the full replicated
+    flat buffer, choosing the cheapest lowering the trace allows (see
+    the vma discussion in ``distributed.py``)."""
+    from .distributed import vma_tracking_live
+    if not vma_tracking_live(axis_name):
+        return lax.all_gather(new_local, axis_name, tiled=True)
+    if _all_gather_invariant is not None:
+        # Varying -> Invariant all-gather (r3, VERDICT r2 weak #8):
+        # the plain all_gather's output is *typed* varying even though
+        # it is semantically replicated, which would force a costly
+        # masked-psum workaround; this primitive carries the
+        # replicated type (and transposes to a cheap dynamic_slice),
+        # so the default-config user pays one real all-gather — the
+        # same collective as with check_vma=False.
+        #
+        # It is a PRIVATE jax API (jax._src.lax.parallel), so its
+        # signature may drift between releases; a TypeError here must
+        # degrade to the masked-psum fallback below, not explode at
+        # trace time (ADVICE r3).
+        try:
+            return _all_gather_invariant(new_local, axis_name, tiled=True)
+        except TypeError:
+            pass
+    # Very old jax without the primitive: gather as a masked psum
+    # (invariant output) — a full all-reduce of a zeros-placed
+    # buffer, correct but 2x the bytes on the wire.
+    placed = lax.dynamic_update_slice_in_dim(
+        jnp.zeros_like(flat_like), new_local, idx * chunk, axis=0)
+    return lax.psum(placed, axis_name)
+
+
+def _shard_one(flat_p, flat_g, state_inner, tx, n, idx, num_shards,
+               axis_name, apply_mask, kw):
+    """reduce-scatter + local update + gather for ONE flat buffer."""
+    chunk0 = -(-flat_p.size // num_shards)
+    pad = chunk0 * num_shards - flat_p.size
+    if pad:
+        flat_p = jnp.pad(flat_p, (0, pad))
+        flat_g = jnp.pad(flat_g, (0, pad))
+    chunk = flat_p.size // n
+    # reduce-scatter(mean): the DDP gradient averaging, at half an
+    # all-reduce, delivering only this rank's chunk.
+    g_local = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                               tiled=True) / n
+    p_local = lax.dynamic_slice_in_dim(flat_p, idx * chunk, chunk)
+    new_p_local, new_inner = tx.update(
+        g_local, state_inner, p_local, apply_mask=apply_mask, **kw)
+    flat_new = _gather_replicated(new_p_local, flat_p, idx, chunk,
+                                  axis_name)
+    if pad:
+        flat_new = flat_new[:flat_p.size - pad]
+    return flat_new, new_inner
+
+
+def zero1(tx, axis_name: str, *, num_shards: int, bucketed: bool = False):
     """Wrap a :class:`~apex_tpu.training.FunctionalOptimizer` with ZeRO-1
     state sharding over ``axis_name`` (``num_shards`` = axis size, needed
     at init time, which runs outside shard_map).
@@ -89,6 +150,12 @@ def zero1(tx, axis_name: str, *, num_shards: int):
     ``reduce_grads=False`` and keep ``axis_name`` set (the step still
     needs it for the mesh-wide overflow agreement under dynamic scaling
     and for the metric pmean).
+
+    ``bucketed=True`` routes the flat view through a
+    :class:`~apex_tpu.multi_tensor.BucketStore`: one padded flat bucket
+    per parameter dtype, each sharded over the axis with its own inner
+    optimizer state — mixed-dtype trees work, collectives stay
+    O(buckets).
     """
     from ..training import FunctionalOptimizer
 
@@ -106,6 +173,41 @@ def zero1(tx, axis_name: str, *, num_shards: int):
         chunk = -(-n_elems // num_shards)
         return chunk * num_shards
 
+    if bucketed:
+        from ..multi_tensor.buckets import BucketStore, cached_store
+
+        cell = {}
+
+        def _store(params) -> BucketStore:
+            return cached_store(cell, params)
+
+        def init(params):
+            packed = _store(params).pack(params)
+            inner = tuple(
+                tx.init(jnp.pad(b, (0, _padded_len(b.size) - b.size)))
+                for b in packed.data)
+            return Zero1State(inner=inner)
+
+        def update(grads, state, params, *, apply_mask=None, **kw):
+            store = _store(params)
+            n = _axis_size(axis_name)
+            idx = lax.axis_index(axis_name)
+            packed_p = store.pack(params)
+            packed_g = store.pack(grads, cast=True)
+            new_data, new_inner = [], []
+            for flat_p, flat_g, st in zip(packed_p.data, packed_g.data,
+                                          state.inner):
+                flat_new, ni = _shard_one(
+                    flat_p, flat_g.astype(flat_p.dtype), st, tx, n, idx,
+                    num_shards, axis_name, apply_mask, kw)
+                new_data.append(flat_new)
+                new_inner.append(ni)
+            from ..multi_tensor.buckets import Packed
+            out = Packed(data=tuple(new_data), rest=packed_p.rest)
+            return store.unpack(out), Zero1State(inner=tuple(new_inner))
+
+        return FunctionalOptimizer(init=init, update=update)
+
     def init(params):
         flat = _flatten(params)
         pad = _padded_len(flat.size) - flat.size
@@ -117,51 +219,9 @@ def zero1(tx, axis_name: str, *, num_shards: int):
         idx = lax.axis_index(axis_name)
         flat_p = _flatten(params)
         flat_g = _flatten(grads).astype(flat_p.dtype)
-        pad = _padded_len(flat_p.size) - flat_p.size
-        if pad:
-            flat_p = jnp.pad(flat_p, (0, pad))
-            flat_g = jnp.pad(flat_g, (0, pad))
-        chunk = flat_p.size // n
-        # reduce-scatter(mean): the DDP gradient averaging, at half an
-        # all-reduce, delivering only this rank's chunk.
-        g_local = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
-                                   tiled=True) / n
-        p_local = lax.dynamic_slice_in_dim(flat_p, idx * chunk, chunk)
-        new_p_local, new_inner = tx.update(
-            g_local, state.inner, p_local, apply_mask=apply_mask, **kw)
-        from .distributed import vma_tracking_live
-        if not vma_tracking_live(axis_name):
-            flat_new = lax.all_gather(new_p_local, axis_name, tiled=True)
-        elif _all_gather_invariant is not None:
-            # Varying -> Invariant all-gather (r3, VERDICT r2 weak #8):
-            # the plain all_gather's output is *typed* varying even though
-            # it is semantically replicated, which would force a costly
-            # masked-psum workaround; this primitive carries the
-            # replicated type (and transposes to a cheap dynamic_slice),
-            # so the default-config user pays one real all-gather — the
-            # same collective as with check_vma=False.
-            #
-            # It is a PRIVATE jax API (jax._src.lax.parallel), so its
-            # signature may drift between releases; a TypeError here must
-            # degrade to the masked-psum fallback below, not explode at
-            # trace time (ADVICE r3).
-            try:
-                flat_new = _all_gather_invariant(new_p_local, axis_name,
-                                                 tiled=True)
-            except TypeError:
-                placed = lax.dynamic_update_slice_in_dim(
-                    jnp.zeros_like(flat_p), new_p_local, idx * chunk,
-                    axis=0)
-                flat_new = lax.psum(placed, axis_name)
-        else:
-            # Very old jax without the primitive: gather as a masked psum
-            # (invariant output) — a full all-reduce of a zeros-placed
-            # buffer, correct but 2x the bytes on the wire.
-            placed = lax.dynamic_update_slice_in_dim(
-                jnp.zeros_like(flat_p), new_p_local, idx * chunk, axis=0)
-            flat_new = lax.psum(placed, axis_name)
-        if pad:
-            flat_new = flat_new[:flat_p.size - pad]
+        flat_new, new_inner = _shard_one(
+            flat_p, flat_g, state.inner, tx, n, idx, num_shards,
+            axis_name, apply_mask, kw)
         return _unflatten(flat_new, params), Zero1State(inner=new_inner)
 
     return FunctionalOptimizer(init=init, update=update)

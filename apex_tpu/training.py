@@ -98,25 +98,65 @@ class FunctionalOptimizer(NamedTuple):
     elementwise: bool = False
 
 
-def adam(lr=1e-3, **kw) -> FunctionalOptimizer:
+def _bucketed_tx(init_fn, update_fn, *, elementwise) -> FunctionalOptimizer:
+    """FunctionalOptimizer over the flat-bucket engine (ISSUE 4): the
+    BucketStore is built lazily from the first ``init(params)`` call (a
+    static shape/dtype read — safe under jit tracing), and the optimizer
+    state lives as a few large ``Packed`` buffers, so a ``lax.scan``
+    carry (``runtime.StepPipeline`` K-step device loops) holds O(buckets)
+    moment arrays instead of two per parameter leaf."""
+    cell = {}
+
+    def _store(params):
+        from .multi_tensor.buckets import cached_store
+        return cached_store(cell, params)
+
+    def init(params):
+        return init_fn(params, store=_store(params))
+
+    def update(grads, state, params, **kw):
+        return update_fn(grads, state, params, store=_store(params), **kw)
+
+    return FunctionalOptimizer(init, update, elementwise=elementwise)
+
+
+def adam(lr=1e-3, *, bucketed=False, **kw) -> FunctionalOptimizer:
+    if bucketed:
+        return _bucketed_tx(F.adam_init,
+                            functools.partial(F.adam_update, lr=lr, **kw),
+                            elementwise=True)
     return FunctionalOptimizer(
         F.adam_init, functools.partial(F.adam_update, lr=lr, **kw),
         elementwise=True)
 
 
-def sgd(lr=1e-3, momentum=0.0, **kw) -> FunctionalOptimizer:
+def sgd(lr=1e-3, momentum=0.0, *, bucketed=False, **kw) -> FunctionalOptimizer:
+    if bucketed:
+        return _bucketed_tx(
+            functools.partial(F.sgd_init, momentum=momentum),
+            functools.partial(F.sgd_update, lr=lr, momentum=momentum, **kw),
+            elementwise=True)
     return FunctionalOptimizer(
         functools.partial(F.sgd_init, momentum=momentum),
         functools.partial(F.sgd_update, lr=lr, momentum=momentum, **kw),
         elementwise=True)
 
 
-def lamb(lr=1e-3, **kw) -> FunctionalOptimizer:
+def lamb(lr=1e-3, *, bucketed=False, **kw) -> FunctionalOptimizer:
+    if bucketed:
+        return _bucketed_tx(F.lamb_init,
+                            functools.partial(F.lamb_update, lr=lr, **kw),
+                            elementwise=False)
     return FunctionalOptimizer(
         F.lamb_init, functools.partial(F.lamb_update, lr=lr, **kw))
 
 
-def novograd(lr=1e-3, **kw) -> FunctionalOptimizer:
+def novograd(lr=1e-3, *, bucketed=False, **kw) -> FunctionalOptimizer:
+    if bucketed:
+        return _bucketed_tx(
+            F.novograd_init,
+            functools.partial(F.novograd_update, lr=lr, **kw),
+            elementwise=False)
     return FunctionalOptimizer(
         F.novograd_init, functools.partial(F.novograd_update, lr=lr, **kw))
 

@@ -15,7 +15,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
-FAST="python -m pytest tests/test_install_matrix.py -q"
+# test_multi_tensor.py rides along for the flat-bucket matrix (ISSUE 4):
+# the bucket engine is pure XLA, so every degradation tier must keep its
+# numerics bit-identical.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J007 a known-bad snippet
+1. fixture self-tests — for every rule J001-J008 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -446,6 +446,103 @@ def test_j007_outside_loop_passes():
         state = step(state, window)
     """
     assert _codes(src, "examples/demo.py") == []
+
+
+# -- J008: per-leaf host syncs in tree_leaves loops ---------------------------
+
+_J008_BAD = """
+import jax
+import jax.numpy as jnp
+
+def grad_norms(grads):
+    out = []
+    for g in jax.tree_util.tree_leaves(grads):
+        leaf_norm = jnp.sqrt(jnp.sum(g * g))
+        out.append(float(leaf_norm))
+    return out
+"""
+
+
+def test_j008_flags_per_leaf_sync_and_not_j001():
+    """The ISSUE-4 fixture: float(leaf_norm) inside a loop over
+    tree_leaves is the O(leaves)-round-trips sweep — reported as the
+    specific J008, not a garden-variety J001."""
+    assert _codes(_J008_BAD) == ["J008"]
+
+
+def test_j008_waiver_with_reason_passes():
+    waived = _J008_BAD.replace(
+        "out.append(float(leaf_norm))",
+        "out.append(float(leaf_norm))  # jaxlint: disable=J008 -- fixture")
+    assert _codes(waived) == []
+
+
+def test_j008_device_side_reduction_is_the_fix():
+    fixed = """
+    import jax
+    import jax.numpy as jnp
+
+    def grad_norms(grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return jnp.stack([jnp.sqrt(jnp.sum(g * g)) for g in leaves])
+    """
+    assert _codes(fixed) == []
+
+
+def test_j008_tree_flatten_binding_and_driver_context():
+    bad = """
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for l in leaves:
+        print(np.asarray(l))
+    """
+    assert _codes(bad, "examples/demo.py") == ["J008"]
+    bad_sub = bad.replace(
+        "leaves, treedef = jax.tree_util.tree_flatten(tree)",
+        "leaves = jax.tree_util.tree_flatten(tree)[0]")
+    assert _codes(bad_sub, "examples/demo.py") == ["J008"]
+
+
+def test_j008_zip_over_leaf_lists_flags():
+    bad = """
+    import jax
+
+    def drain(a, b):
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            jax.device_get(x + y)
+    """
+    assert _codes(bad) == ["J008"]
+
+
+def test_j008_leafless_loop_still_plain_j001():
+    # an ordinary array loop stays J001 — J008 is only the tree sweep
+    src = """
+    import jax.numpy as jnp
+
+    losses = jnp.ones(8)
+    for l in losses:
+        print(float(l))
+    """
+    assert _codes(src, "examples/demo.py") == ["J001"]
+
+
+def test_j008_host_boundary_funcs_stay_exempt():
+    # serialization materializes per leaf by contract, like J001
+    src = """
+    import jax
+    import numpy as np
+
+    class Opt:
+        def state_dict(self):
+            out = []
+            for l in jax.tree_util.tree_leaves(self.state):
+                out.append(np.asarray(l))
+            return out
+    """
+    assert _codes(src) == []
 
 
 # -- J000: waiver hygiene -----------------------------------------------------

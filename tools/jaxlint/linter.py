@@ -1,6 +1,6 @@
 """jaxlint core — AST rules, waiver handling, and the lint engine.
 
-Six rules tuned to this codebase's failure modes (the ones that are
+Eight rules tuned to this codebase's failure modes (the ones that are
 invisible to pytest and surface as 10x dispatch-floor regressions in
 ``bench.py``):
 
@@ -36,6 +36,16 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   (:class:`apex_tpu.data.PrefetchLoader` / ``stage_windows``), where it
   overlaps compute, not on the hot loop where it serializes with it
   (ISSUE 3: the input-side twin of the J001 sync stalls).
+* **J008** per-leaf host syncs in loops over pytree leaves: a J001-class
+  sync (``float()``/``.item()``/``np.asarray``/``device_get``) inside a
+  loop whose iterable comes from ``jax.tree_util.tree_leaves`` /
+  ``tree_flatten`` — e.g. ``float(leaf_norm)`` per grad leaf.  One sync
+  per step caps throughput at a round-trip; one per LEAF multiplies that
+  by the model depth (O(leaves) drains per sweep).  Compute the
+  reduction on device (``tree_finite`` / ``multi_tensor_l2norm``, one
+  reduce per bucket with a ``BucketStore``) and fetch ONE value, or
+  stack the per-leaf values into a single transfer (ISSUE 4: the
+  tree-sweep twin of the J001 stalls).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -68,6 +78,9 @@ RULES: Dict[str, str] = {
     "J006": "Python control flow branching on a traced value under jit",
     "J007": "per-step host staging (device_put/asarray on batch data in a "
             "loop; stage in the loader)",
+    "J008": "per-leaf host sync in a loop over tree_leaves/tree_flatten "
+            "(O(leaves) round-trips; reduce on device or batch into one "
+            "transfer)",
 }
 
 # Functions whose *contract* is the host boundary: serialization must
@@ -629,27 +642,33 @@ class _ScopeWalker:
         # batch stream): per-step device_put/asarray on these is the
         # J007 host-staging-in-the-hot-loop finding.
         self.batch_vars: Set[str] = set()
+        # Locals bound from tree_leaves/tree_flatten results: loops over
+        # them are PER-LEAF sweeps, where a sync is J008 (O(leaves)
+        # round-trips), not a garden-variety J001.
+        self.leafish: Set[str] = set()
         self.jit_scoped = (fn is not None
                            and fn.name in self.idx.jitted_defs)
-        self._stmts(body, loop_depth=0, loop_vars=frozenset())
+        self._stmts(body, loop_depth=0, loop_vars=frozenset(),
+                    leaf_loop=False)
 
     def _stmts(self, body: List[ast.stmt], loop_depth: int,
-               loop_vars: frozenset) -> None:
+               loop_vars: frozenset, leaf_loop: bool) -> None:
         for stmt in body:
-            self._stmt(stmt, loop_depth, loop_vars)
+            self._stmt(stmt, loop_depth, loop_vars, leaf_loop)
 
     def _stmt(self, stmt: ast.stmt, loop_depth: int,
-              loop_vars: frozenset) -> None:
+              loop_vars: frozenset, leaf_loop: bool) -> None:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return                      # nested defs are separate scopes
         if isinstance(stmt, ast.Assign):
             self._track_arrayish(stmt)
+            self._track_leafish(stmt)
             self._check_j005_stmt(stmt, loop_depth)
         elif isinstance(stmt, ast.Expr):
             self._check_j005_stmt(stmt, loop_depth)
         # expression-level checks on this statement's own expressions
-        self._exprs(stmt, loop_depth, loop_vars)
+        self._exprs(stmt, loop_depth, loop_vars, leaf_loop)
         # recurse into compound statements
         if isinstance(stmt, (ast.For, ast.AsyncFor)):
             new_vars = loop_vars | self._scalar_loop_vars(stmt)
@@ -661,7 +680,8 @@ class _ScopeWalker:
             # passed the sweep).  Scalar counters (range/enumerate) are
             # excluded; zip over mixed iterables over-approximates, per
             # the waiver contract.
-            if _is_arrayish(stmt.iter, self.arrayish):
+            in_leaf_loop = leaf_loop or self._is_leaves_expr(stmt.iter)
+            if in_leaf_loop or _is_arrayish(stmt.iter, self.arrayish):
                 for n in ast.walk(stmt.target):
                     if isinstance(n, ast.Name) and n.id not in new_vars:
                         self.arrayish.add(n.id)
@@ -672,23 +692,23 @@ class _ScopeWalker:
                 for n in ast.walk(stmt.target):
                     if isinstance(n, ast.Name) and n.id not in new_vars:
                         self.batch_vars.add(n.id)
-            self._stmts(stmt.body, loop_depth + 1, new_vars)
-            self._stmts(stmt.orelse, loop_depth, loop_vars)
+            self._stmts(stmt.body, loop_depth + 1, new_vars, in_leaf_loop)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
         elif isinstance(stmt, ast.While):
-            self._stmts(stmt.body, loop_depth + 1, loop_vars)
-            self._stmts(stmt.orelse, loop_depth, loop_vars)
+            self._stmts(stmt.body, loop_depth + 1, loop_vars, leaf_loop)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
         elif isinstance(stmt, ast.If):
             self._check_j006(stmt)
-            self._stmts(stmt.body, loop_depth, loop_vars)
-            self._stmts(stmt.orelse, loop_depth, loop_vars)
+            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-            self._stmts(stmt.body, loop_depth, loop_vars)
+            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop)
         elif isinstance(stmt, ast.Try):
-            self._stmts(stmt.body, loop_depth, loop_vars)
+            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop)
             for h in stmt.handlers:
-                self._stmts(h.body, loop_depth, loop_vars)
-            self._stmts(stmt.orelse, loop_depth, loop_vars)
-            self._stmts(stmt.finalbody, loop_depth, loop_vars)
+                self._stmts(h.body, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.finalbody, loop_depth, loop_vars, leaf_loop)
 
     @staticmethod
     def _scalar_loop_vars(stmt) -> frozenset:
@@ -709,6 +729,54 @@ class _ScopeWalker:
                 and isinstance(stmt.target.elts[0], ast.Name):
             return frozenset({stmt.target.elts[0].id})
         return frozenset()
+
+    # tree-leaves iterables feeding J008 (per-leaf sync sweeps)
+    _TREE_LEAVES_CALLS = ("jax.tree_util.tree_leaves", "jax.tree_leaves",
+                          "tree_leaves", "jax.tree.leaves",
+                          "tree_util.tree_leaves")
+    _TREE_FLATTEN_CALLS = ("jax.tree_util.tree_flatten", "jax.tree_flatten",
+                           "tree_flatten", "jax.tree.flatten",
+                           "tree_util.tree_flatten")
+
+    def _is_leaves_expr(self, node: ast.AST) -> bool:
+        """Does this expression yield the leaf list of a pytree?
+        ``tree_leaves(...)``, ``tree_flatten(...)[0]``, or a local bound
+        from either."""
+        if isinstance(node, ast.Call) \
+                and _dotted(node.func) in self._TREE_LEAVES_CALLS:
+            return True
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Call) \
+                and _dotted(node.value.func) in self._TREE_FLATTEN_CALLS:
+            sl = node.slice
+            return isinstance(sl, ast.Constant) and sl.value == 0
+        if isinstance(node, ast.Name) and node.id in self.leafish:
+            return True
+        # zip(leaves_a, leaves_b, ...): per-leaf lockstep sweep
+        if isinstance(node, ast.Call) and _dotted(node.func) == "zip":
+            return any(self._is_leaves_expr(a) for a in node.args)
+        return False
+
+    def _track_leafish(self, stmt: ast.Assign) -> None:
+        if len(stmt.targets) != 1:
+            return
+        t, v = stmt.targets[0], stmt.value
+        if isinstance(t, ast.Name):
+            if self._is_leaves_expr(v):
+                self.leafish.add(t.id)
+            else:
+                self.leafish.discard(t.id)
+            return
+        # ``leaves, treedef = tree_flatten(tree)``
+        if isinstance(t, ast.Tuple) and t.elts \
+                and isinstance(t.elts[0], ast.Name) \
+                and isinstance(v, ast.Call) \
+                and _dotted(v.func) in self._TREE_FLATTEN_CALLS:
+            self.leafish.add(t.elts[0].id)
+            return
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                self.leafish.discard(n.id)
 
     def _track_arrayish(self, stmt: ast.Assign) -> None:
         # Results of a known-jitted callable are device arrays too —
@@ -747,7 +815,7 @@ class _ScopeWalker:
                 self.arrayish.discard(name)
 
     def _exprs(self, stmt: ast.stmt, loop_depth: int,
-               loop_vars: frozenset) -> None:
+               loop_vars: frozenset, leaf_loop: bool) -> None:
         # own expressions only (not nested statements/defs)
         for expr in ast.iter_child_nodes(stmt):
             if isinstance(expr, (ast.stmt, ast.FunctionDef)):
@@ -755,16 +823,17 @@ class _ScopeWalker:
             if isinstance(expr, ast.expr):
                 for sub in ast.walk(expr):
                     if isinstance(sub, ast.Call):
-                        self._check_j001_call(sub, loop_depth)
+                        self._check_j001_call(sub, loop_depth, leaf_loop)
                         self._check_j004_call(sub, loop_depth, loop_vars)
                         self._check_j007_call(sub, loop_depth)
         # While tests live on the stmt itself
         if isinstance(stmt, ast.While):
             self._check_j006(stmt)
 
-    # .. J001 .................................................................
+    # .. J001 / J008 ..........................................................
 
-    def _check_j001_call(self, call: ast.Call, loop_depth: int) -> None:
+    def _check_j001_call(self, call: ast.Call, loop_depth: int,
+                         leaf_loop: bool = False) -> None:
         sync: Optional[str] = None
         d = _dotted(call.func)
         if d in ("jax.device_get", "jax.block_until_ready"):
@@ -785,6 +854,18 @@ class _ScopeWalker:
         if sync is None:
             return
         if self.fn_name in _J001_HOST_BOUNDARY_FUNCS:
+            return
+        if leaf_loop:
+            # The per-LEAF sweep variant (ISSUE 4): O(leaves) round-trips
+            # per sweep, the multiplied form of the J001 stall.  More
+            # specific rule, reported INSTEAD of J001.
+            self.findings.append(Finding(
+                self.path, call.lineno, call.col_offset, "J008",
+                f"per-leaf host sync {sync} in a loop over pytree leaves "
+                f"— O(leaves) device round-trips per sweep; reduce on "
+                f"device (tree_finite / multi_tensor_l2norm, one reduce "
+                f"per bucket with a BucketStore) and fetch ONE value, or "
+                f"stack the per-leaf values into a single transfer"))
             return
         if self.driver and loop_depth == 0:
             return
@@ -988,7 +1069,8 @@ def lint_source(src: str, path: str = "<string>",
     seen: Set[tuple] = set()
     unique = []
     for f in sorted(kept, key=lambda f: (f.line, f.col, f.rule)):
-        k = (f.line, f.rule) if f.rule == "J001" else (f.line, f.col, f.rule)
+        k = ((f.line, f.rule) if f.rule in ("J001", "J008")
+             else (f.line, f.col, f.rule))
         if k in seen:
             continue
         seen.add(k)
