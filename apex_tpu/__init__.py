@@ -14,6 +14,9 @@ snapshot surveyed in SURVEY.md), designed TPU-first:
   copies / axpby / norms with a device-side overflow flag.
 * profiling (``apex_tpu.prof``) — named-scope capture + per-op flops/bytes
   analysis of jaxprs (the pyprof analog).
+* run telemetry (``apex_tpu.telemetry``) — structured JSONL event stream
+  + metrics registry for live runs; offline analysis via
+  ``python -m apex_tpu.prof.timeline``.
 * legacy surfaces: ``bf16_utils`` (= reference fp16_utils), ``RNN``,
   ``reparameterization``, ``contrib``.
 """
@@ -29,7 +32,7 @@ import importlib as _importlib
 
 _LAZY = ("optimizers", "normalization", "parallel", "bf16_utils", "fp16_utils",
          "RNN", "reparameterization", "contrib", "prof", "training", "models",
-         "runtime", "data")
+         "runtime", "data", "telemetry")
 
 
 def __getattr__(name):

@@ -136,6 +136,7 @@ class LossScaler:
         self._scale_window = scale_window
         self._min_loss_scale = min_loss_scale
         self._max_loss_scale = max_loss_scale
+        self._imp_steps = 0      # imperative update count (telemetry)
         self._state = self.init()
 
     # -- functional core -----------------------------------------------------
@@ -236,6 +237,18 @@ class LossScaler:
         ``should_skip`` for the step-skipping contract."""
         should_skip = bool(jax.device_get(self._state.overflow)) and self.dynamic  # jaxlint: disable=J001 -- the documented ONE sync per imperative step (reference overflow_buf.item()); prefer update_scale_deferred to batch it
         self._state = self.update_scale(self._state)
+        self._imp_steps += 1
+        if should_skip:
+            # Telemetry (ISSUE 5): the imperative twin of the scale
+            # events the recorder derives from fetched window metrics on
+            # the functional path.  The overflow flag was just read
+            # above — no extra sync.
+            from .. import telemetry as _telemetry
+            rec = _telemetry.get_recorder()
+            if rec is not None:
+                rec.metrics.counter("loss_scale_skips").inc()
+                rec.event("scale", event="skip", step=self._imp_steps - 1,
+                          source="imperative")
         return should_skip
 
     def update_scale_deferred(self):
@@ -257,6 +270,7 @@ class LossScaler:
         flag changes."""
         flag = self._state.overflow if self.dynamic else None
         self._state = self.update_scale(self._state)
+        self._imp_steps += 1
         return flag
 
     @property

@@ -40,6 +40,7 @@ import jax
 import numpy as np
 
 from . import native
+from . import telemetry as _telemetry
 
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
@@ -146,8 +147,13 @@ class LoaderStats:
         with self._lock:
             self.staged += 1
 
-    def snapshot(self) -> dict:
-        """Point-in-time view of the counters plus derived percentages."""
+    def as_dict(self) -> dict:
+        """ONE consistent read of every counter plus derived percentages,
+        taken under the stats lock — the single snapshot both
+        :func:`format_loader_line` and the telemetry recorder consume
+        (ISSUE 5 satellite: field-by-field reads could tear under the
+        worker pool — e.g. a ``consumer_wait_s`` from one delivery paired
+        with an ``elapsed_s`` from the next)."""
         with self._lock:
             elapsed = (time.perf_counter() - self._t0) if self._t0 else 0.0
             depth = (self._depth_sum / self._depth_samples
@@ -165,6 +171,11 @@ class LoaderStats:
                     round(100.0 * self.consumer_wait_s / elapsed, 2)
                     if elapsed > 0 else 0.0),
             }
+
+    def snapshot(self) -> dict:
+        """Alias of :meth:`as_dict` (the historical name; both return the
+        same single consistent read)."""
+        return self.as_dict()
 
 
 def format_loader_line(stats: dict) -> str:
@@ -207,7 +218,8 @@ class PrefetchLoader:
 
     def __init__(self, it, depth: int = 2,
                  transform: Optional[Callable] = None,
-                 device=None, workers: int = 1, ordered: bool = True):
+                 device=None, workers: int = 1, ordered: bool = True,
+                 telemetry=None):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
         self._it = it
@@ -218,12 +230,31 @@ class PrefetchLoader:
         self._ordered = ordered
         self.stats = LoaderStats()
         self._live: list = []  # (stop Event, [Thread], Queue, sentinel)
+        # Telemetry (ISSUE 5): explicit Recorder, or None to defer to the
+        # active one per event.  Events ride the loader's own threads;
+        # with no recorder installed every site is one global read.
+        self._telemetry = telemetry
+
+    def _rec(self):
+        return (self._telemetry if self._telemetry is not None
+                else _telemetry.get_recorder())
+
+    def _emit_loader_snapshot(self, phase: str) -> None:
+        """One ``loader`` event carrying the SAME consistent
+        ``LoaderStats.as_dict()`` snapshot the examples print — the
+        analyzer's stall attribution therefore agrees with
+        ``format_loader_line`` by construction."""
+        rec = self._rec()
+        if rec is not None:
+            rec.event("loader", phase=phase, stats=self.stats.as_dict())
 
     def close(self) -> None:
         """Release every pipeline this loader started: set the stop
         events, drain the queues (dropping any staged device batches so
         their HBM frees), and join the threads."""
         live, self._live = self._live, []
+        if live:
+            self._emit_loader_snapshot("close")
         for stop, threads, q, sentinel in live:
             stop.set()
             while True:
@@ -333,7 +364,7 @@ class PrefetchLoader:
 
         def stage():
             while not stop.is_set():
-                item, got, exhausted = None, False, False
+                item, got, exhausted, seq_no = None, False, False, None
                 with cond:
                     while not stop.is_set():
                         ready = st["ready"]
@@ -352,6 +383,7 @@ class PrefetchLoader:
                     if stop.is_set():
                         return
                     if got:
+                        seq_no = st["staged_n"]
                         st["staged_n"] += 1
                         cond.notify_all()
                 if exhausted:       # put OUTSIDE cond: it can block on a
@@ -376,8 +408,14 @@ class PrefetchLoader:
                     _put(LoaderError(e))
                     _put(_SENTINEL)
                     return
-                stats._add("stage_s", time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                stats._add("stage_s", dt)
                 stats._staged_one()
+                rec = self._rec()
+                if rec is not None:
+                    # Runs on the staging thread — never on the hot loop.
+                    rec.event("stage", seq=seq_no, dur=round(dt, 6))
+                    rec.metrics.histogram("stage_s").observe(dt)
                 if not _put(item):
                     return
 
@@ -395,12 +433,20 @@ class PrefetchLoader:
                 stats._start()
                 t0 = time.perf_counter()
                 item = q.get()
-                stats._add("consumer_wait_s", time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                stats._add("consumer_wait_s", dt)
                 if item is _SENTINEL:
+                    self._emit_loader_snapshot("exhausted")
                     break
                 if isinstance(item, LoaderError):
                     raise item.exc
-                stats._delivered(q.qsize())
+                qdepth = q.qsize()
+                stats._delivered(qdepth)
+                rec = self._rec()
+                if rec is not None:
+                    rec.event("loader_wait", dur=round(dt, 6),
+                              qdepth=qdepth)
+                    rec.metrics.histogram("loader_wait_s").observe(dt)
                 yield item
         finally:
             # GeneratorExit (break / del) lands here: release the pipeline.

@@ -32,12 +32,14 @@ triggers one retrace on the next step, matching the rare-change pattern).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry as _telemetry
 from ..amp import policy as _policy
 from ..amp._amp_state import maybe_print
 from ..multi_tensor.buckets import BucketStore, Packed
@@ -87,6 +89,7 @@ class FusedOptimizer:
         self._master_grads = None          # unscaled fp32 grads, step() input
         self._jit_update = None
         self._jit_key = None
+        self._step_count = 0               # step() calls incl skips (telemetry)
 
     # -- group plumbing -----------------------------------------------------
     def _to_groups(self, tree):
@@ -452,6 +455,9 @@ class FusedOptimizer:
         param groups the grads structure is ``[grads_group0, ...]``."""
         if closure is not None:
             closure()
+        rec = _telemetry.get_recorder()
+        step_idx = self._step_count
+        self._step_count += 1
         self._resolve_pending_overflows()
         if self._skip_next_step:
             # One-shot skip; clears itself like the reference's
@@ -460,6 +466,12 @@ class FusedOptimizer:
             self._master_grads = None
             maybe_print("apex_tpu.amp: skipping optimizer step "
                         "(gradient overflow)")
+            if rec is not None:
+                # Skip event with the optimizer's own step index — the
+                # deferred flags were just resolved, no extra sync.
+                rec.metrics.counter("loss_scale_skips").inc()
+                rec.event("scale", event="skip", step=step_idx,
+                          source="optimizer")
             return self.params
 
         if grads is None:
@@ -473,8 +485,13 @@ class FusedOptimizer:
 
         targets = (self._masters if self._masters is not None
                    else [g["params"] for g in self.param_groups])
-        new_params, self.state = self._run_update(
-            self._to_groups(grads), targets, jnp.float32(1.0))
+        # With a recorder, span the host DISPATCH time of the
+        # whole-model update (async) — one call site either way.
+        span = (contextlib.nullcontext() if rec is None
+                else rec.span("opt_step", step=step_idx))
+        with span:
+            new_params, self.state = self._run_update(
+                self._to_groups(grads), targets, jnp.float32(1.0))
 
         if self._masters is not None:
             self._masters = new_params

@@ -29,11 +29,25 @@ or functionally: ``reduce_gradients(grads, axis_name="data", ...)``.
 from __future__ import annotations
 
 import contextlib
+import math
 from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _note_collective(op: str, axis_names, tree_bytes: int, n: int,
+                     dtype=None) -> None:
+    """Report one collective's per-invocation traffic to the active
+    telemetry recorder (ISSUE 5).  Runs at TRACE time — the byte counts
+    are static aval properties — so the compiled program is unchanged
+    and the event appears once per compile, not once per step."""
+    from .. import telemetry as _telemetry
+    rec = _telemetry.get_recorder()
+    if rec is not None and n:
+        rec.note_collective(op, axis_names, tree_bytes, n,
+                            dtype=str(dtype) if dtype is not None else None)
 
 
 def _axis_size(axis_name) -> int:
@@ -234,10 +248,21 @@ def reduce_gradients(grads,
             return axis_names
         return tuple(a for a in axis_names if a in vma)
 
+    # Telemetry collector: per-leaf (or per-bucket) psum bytes summed at
+    # trace time into ONE ``collective`` event per reduce_gradients call.
+    coll = {"bytes": 0, "n": 0, "dtypes": set()}
+
     def one(g):
         if not _is_float(g):
             return g
         need = _axes_still_varying(g)
+        if need:
+            wire_dtype = (jnp.dtype(jnp.float32) if allreduce_always_fp32
+                          else jnp.dtype(g.dtype))
+            coll["bytes"] += ((math.prod(g.shape) if g.shape else 1)
+                              * wire_dtype.itemsize)
+            coll["n"] += 1
+            coll["dtypes"].add(str(wire_dtype))
         if not need:
             # Fully pre-summed by the implicit psum — which spans the FULL
             # axes (subgroup structure is invisible to the transpose), so
@@ -275,14 +300,27 @@ def reduce_gradients(grads,
         return g
 
     from ..multi_tensor.buckets import Packed
+
+    def _wire_dtype():
+        # One dtype crossed the wire, or an honest "mixed" label — a
+        # last-leaf-wins dtype would misattribute the summed bytes.
+        if len(coll["dtypes"]) == 1:
+            return next(iter(coll["dtypes"]))
+        return "mixed" if coll["dtypes"] else None
+
     if bucket_store is not None or isinstance(grads, Packed):
         packed = (grads if isinstance(grads, Packed)
                   else bucket_store.pack(grads))
         out = jax.tree_util.tree_map(one, packed)   # one() per BUCKET
+        _note_collective("psum", axis_names, coll["bytes"], coll["n"],
+                         dtype=_wire_dtype())
         if isinstance(grads, Packed):
             return out
         return bucket_store.unpack(out)
-    return jax.tree_util.tree_map(one, grads)
+    out = jax.tree_util.tree_map(one, grads)
+    _note_collective("psum", axis_names, coll["bytes"], coll["n"],
+                     dtype=_wire_dtype())
+    return out
 
 
 def broadcast_params(params, axis_name: str,

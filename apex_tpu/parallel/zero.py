@@ -118,12 +118,23 @@ def _gather_replicated(new_local, flat_like, idx, chunk, axis_name):
 def _shard_one(flat_p, flat_g, state_inner, tx, n, idx, num_shards,
                axis_name, apply_mask, kw):
     """reduce-scatter + local update + gather for ONE flat buffer."""
+    from .distributed import _note_collective
+
     chunk0 = -(-flat_p.size // num_shards)
     pad = chunk0 * num_shards - flat_p.size
     if pad:
         flat_p = jnp.pad(flat_p, (0, pad))
         flat_g = jnp.pad(flat_g, (0, pad))
     chunk = flat_p.size // n
+    # Telemetry (trace-time, ISSUE 5): the ZeRO-1 collective pair moves
+    # exactly one all-reduce's worth of bytes — half on the scatter,
+    # half on the gather.
+    _note_collective("psum_scatter", axis_name,
+                     flat_g.size * jnp.dtype(flat_g.dtype).itemsize, 1,
+                     dtype=flat_g.dtype)
+    _note_collective("all_gather", axis_name,
+                     flat_p.size * jnp.dtype(flat_p.dtype).itemsize, 1,
+                     dtype=flat_p.dtype)
     # reduce-scatter(mean): the DDP gradient averaging, at half an
     # all-reduce, delivering only this rank's chunk.
     g_local = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,

@@ -16,7 +16,12 @@ Three stages, mapped TPU-natively (SURVEY.md §2.9, §5):
 Plus the compile-behavior assertion :mod:`apex_tpu.prof.trace_count`
 (``assert_trace_count``) — the runtime complement to the static
 ``tools/jaxlint`` J004 retracing rule: wrap it around a jitted step in a
-test to pin "one compile, zero retraces".
+test to pin "one compile, zero retraces" — and the run-telemetry
+analyzer :mod:`apex_tpu.prof.timeline` (``python -m
+apex_tpu.prof.timeline run.jsonl``), which distills the structured
+event streams :mod:`apex_tpu.telemetry` records into step-time
+percentiles, stall/gap attribution, the loss-scale trajectory, retrace
+reports, and per-collective byte totals.
 """
 
 from .analysis import OpRecord, Profile, profile_function   # noqa: F401
@@ -25,4 +30,7 @@ from .capture import (init, annotate, scope, trace,          # noqa: F401
 from .ledger import loader_ledger                            # noqa: F401
 from .parse import (KernelRecord, TraceProfile, parse_trace,  # noqa: F401
                     attach_measured)
+# NOTE: .timeline (the offline stream analyzer) is deliberately NOT
+# imported here — ``python -m apex_tpu.prof.timeline`` would otherwise
+# trip runpy's double-import warning; import it explicitly.
 from .trace_count import assert_trace_count, trace_count     # noqa: F401

@@ -65,7 +65,15 @@ def annotate(name: str = None) -> Callable:
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
             if _enabled:
-                MARKERS.append(_arg_marker(scope_name, args, kwargs))
+                marker = _arg_marker(scope_name, args, kwargs)
+                MARKERS.append(marker)
+                # Telemetry (ISSUE 5): the same marker also lands in the
+                # run's event stream, timestamped — the traceMarker dicts
+                # become tail-able run events instead of a post-hoc dump.
+                from .. import telemetry as _telemetry
+                rec = _telemetry.get_recorder()
+                if rec is not None:
+                    rec.event("marker", **marker)
             with jax.named_scope(scope_name):
                 return fn(*args, **kwargs)
         return wrapped

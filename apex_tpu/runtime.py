@@ -55,6 +55,7 @@ before ``jax.jit``; the window's leading K axis stays unsharded.
 
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Any, Callable, Iterable, Iterator, NamedTuple, Optional
 
@@ -62,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry as _telemetry
 from .training import chain_steps
 
 __all__ = ["StepPipeline", "DeferredMetrics", "WindowMetrics",
@@ -106,7 +108,8 @@ class StepPipeline:
 
     def __init__(self, step_fn: Callable, k: int, *,
                  wrap: Optional[Callable] = None,
-                 donate_window: bool = True):
+                 donate_window: bool = True,
+                 telemetry=None):
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         self.k = int(k)
@@ -114,6 +117,15 @@ class StepPipeline:
         self._wrap = wrap
         donate = (0, 1) if donate_window else (0,)
         self.donate_window = donate_window
+        # Telemetry (ISSUE 5): an explicit Recorder pins this pipeline to
+        # it; None defers to telemetry.get_recorder() per dispatch, so a
+        # recorder installed mid-run is picked up.  With no recorder the
+        # dispatch path below is byte-for-byte the uninstrumented one.
+        self._telemetry = telemetry
+        self._steps_done = 0          # global step index for events
+        self._t_last_dispatch: Optional[float] = None
+        self._traces_seen = {"hot": 0, "tail": 0}
+        self._sigs_seen = {"hot": set(), "tail": set()}
 
         chained = chain_steps(step_fn)
 
@@ -152,11 +164,71 @@ class StepPipeline:
         read them through :class:`DeferredMetrics`).
         """
         if n_valid is None or n_valid >= self.k:
-            return self._dispatch(self.loop, state, window, self._full_valid)
-        if n_valid < 1:
-            raise ValueError(f"n_valid must be >= 1, got {n_valid}")
-        valid = np.arange(self.k) < n_valid      # [K] bool, shape-stable
-        return self._dispatch(self.tail_loop, state, window, valid)
+            loop, valid, n, program = (self.loop, self._full_valid,
+                                       self.k, "hot")
+        else:
+            if n_valid < 1:
+                raise ValueError(f"n_valid must be >= 1, got {n_valid}")
+            # [K] bool, shape-stable
+            loop, valid, n, program = (self.tail_loop,
+                                       np.arange(self.k) < n_valid,
+                                       n_valid, "tail")
+        step0 = self._steps_done
+        self._steps_done += n
+        rec = (self._telemetry if self._telemetry is not None
+               else _telemetry.get_recorder())
+        if rec is None:
+            return self._dispatch(loop, state, window, valid)
+        t0 = time.perf_counter()
+        gap = (0.0 if self._t_last_dispatch is None
+               else t0 - self._t_last_dispatch)
+        out = self._dispatch(loop, state, window, valid)
+        t1 = time.perf_counter()
+        self._t_last_dispatch = t1
+        self._note_retrace(rec, loop, program, window, step0)
+        # dur is the host DISPATCH time (async — the device may still be
+        # running); gap is host time since the previous dispatch returned
+        # (metric fetches, loader waits, python glue).
+        rec.event("window", step=step0, k=self.k, n_valid=n,
+                  dur=round(t1 - t0, 6), gap=round(gap, 6),
+                  program=program)
+        rec.metrics.histogram("window_dispatch_s").observe(t1 - t0)
+        rec.metrics.histogram("window_gap_s").observe(gap)
+        rec.metrics.counter("steps_dispatched").inc(n)
+        return out
+
+    def _note_retrace(self, rec, loop, program: str, window,
+                      step0: int) -> None:
+        """Emit a ``retrace`` event when this dispatch grew the jit
+        tracing cache, keyed by the window's shape signature (one int
+        compare per dispatch; the signature is only built on growth).
+
+        ``first`` marks the program's initial compile; ``new_sig``
+        distinguishes a TRUE retrace (a window shape/dtype signature
+        never traced before — the J004 bug class) from the known-benign
+        call-1 re-specialization, where jit re-caches on the donated
+        state's returned sharding with the SAME signature.  Only
+        not-first + new-sig growth increments the ``retraces`` counter
+        the analyzer and bench gate on."""
+        try:
+            size = loop._cache_size()
+        except Exception:
+            return
+        prev = self._traces_seen.get(program, 0)
+        if size <= prev:
+            return
+        self._traces_seen[program] = size
+        leaves = jax.tree_util.tree_leaves(window)
+        sig = "|".join(f"{getattr(l, 'dtype', type(l).__name__)}"
+                       f"{list(getattr(l, 'shape', ()))}"
+                       for l in leaves[:16])
+        new_sig = sig not in self._sigs_seen[program]
+        self._sigs_seen[program].add(sig)
+        rec.event("retrace", program=program, step=step0,
+                  n_traces=size, first=(prev == 0), new_sig=new_sig,
+                  sig=sig)
+        if prev > 0 and new_sig:
+            rec.metrics.counter("retraces").inc()
 
     def _dispatch(self, loop, state, window, valid):
         if not self.donate_window:
@@ -179,14 +251,15 @@ class StepPipeline:
         called with a :class:`WindowMetrics` one dispatch behind the hot
         loop.  Returns ``(state, reader)``; ``reader.last()`` drains the
         final window's metrics."""
-        reader = DeferredMetrics()
+        reader = DeferredMetrics(telemetry=self._telemetry)
         for window, n_valid in windows:
             state, metrics = self.step_window(state, window, n_valid)
             prev = reader.push(metrics, n_valid)
             if prev is not None and on_metrics is not None:
                 on_metrics(prev)
-        if on_metrics is not None and reader.newest() is not None:
-            on_metrics(reader.newest())
+        if on_metrics is not None:
+            for wm in reader.flush():   # the final in-flight window
+                on_metrics(wm)
         return state, reader
 
 
@@ -200,12 +273,23 @@ class WindowMetrics(NamedTuple):
     step: int
     n_valid: int
     metrics: Any
+    #: optional telemetry Recorder: fetch() reports the transfer to it
+    #: (the piggyback point — telemetry reads ride THIS fetch, never a
+    #: fetch of their own).
+    telemetry: Any = None
 
     def fetch(self):
         """ONE batched device->host transfer of this window's metrics
         (each leaf arrives as a host array stacked ``[K]``; entries past
         ``n_valid`` are padding)."""
-        return jax.device_get(self.metrics)  # jaxlint: disable=J001 -- the deferred reader's contract: one batched transfer, one dispatch behind the hot loop
+        if self.telemetry is None:
+            return jax.device_get(self.metrics)  # jaxlint: disable=J001 -- the deferred reader's contract: one batched transfer, one dispatch behind the hot loop
+        import time as _time
+        t0 = _time.perf_counter()
+        vals = jax.device_get(self.metrics)  # jaxlint: disable=J001 -- same sanctioned transfer as above, timed for the telemetry stream
+        self.telemetry.observe_window_metrics(
+            self.step, self.n_valid, vals, _time.perf_counter() - t0)
+        return vals
 
 
 class DeferredMetrics:
@@ -217,20 +301,31 @@ class DeferredMetrics:
     fetch always trails the newest dispatch by one window, the device is
     already executing window N while the host waits on window N-1's
     values, so the hot loop never drains the pipeline on a scalar.
-    ``last()`` reads the final window at shutdown (this one DOES wait for
-    the device — it is the end-of-training drain)."""
+    At loop exit, :meth:`flush` (or ``last()``) drains the final
+    in-flight window — every pushed window is handed back exactly once
+    between ``push`` returns and one ``flush``, so no metrics window is
+    silently dropped (ISSUE 5 satellite).
 
-    def __init__(self):
+    ``telemetry`` pins a Recorder whose ``observe_window_metrics`` rides
+    each window's fetch; None defers to the active recorder at push
+    time."""
+
+    def __init__(self, telemetry=None):
         self._held: Optional[WindowMetrics] = None
         self._behind: Optional[WindowMetrics] = None
         self._next_step = 0
+        self._telemetry = telemetry
+        self._flushed = False
 
     def push(self, metrics, n_valid: int) -> Optional[WindowMetrics]:
         """Record a freshly dispatched window; returns the previous
         window's handles (or None on the first push)."""
+        rec = (self._telemetry if self._telemetry is not None
+               else _telemetry.get_recorder())
         self._behind = self._held
-        self._held = WindowMetrics(self._next_step, n_valid, metrics)
+        self._held = WindowMetrics(self._next_step, n_valid, metrics, rec)
         self._next_step += n_valid
+        self._flushed = False
         return self._behind
 
     def behind(self) -> Optional[WindowMetrics]:
@@ -242,11 +337,26 @@ class DeferredMetrics:
         device to finish it — end-of-loop use only)."""
         return self._held
 
+    def flush(self) -> list:
+        """Drain the reader: return every window ``push`` has not yet
+        handed back — exactly the newest in-flight one (each earlier
+        window was returned by its successor's ``push``).  Returns
+        ``[WindowMetrics]`` (handles; call ``.fetch()`` to read), or
+        ``[]`` when already drained / nothing was pushed.  Call at loop
+        exit so the final window's metrics are never silently dropped;
+        idempotent until the next ``push``."""
+        if self._held is None or self._flushed:
+            return []
+        self._flushed = True
+        return [self._held]
+
     def last(self) -> Optional[Any]:
         """Fetch the NEWEST window's metrics (host values).  Blocks until
-        the device finishes it — call once, after the loop."""
+        the device finishes it — call once, after the loop.  Equivalent
+        to ``flush()`` + fetch, and marks the reader drained."""
         if self._held is None:
             return None
+        self._flushed = True
         return self._held.fetch()
 
     @property

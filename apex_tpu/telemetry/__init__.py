@@ -1,0 +1,32 @@
+"""apex_tpu.telemetry — run-wide observability engine (ISSUE 5).
+
+The runtime counterpart of the ``prof`` package's static analysis (the
+PyProf pillar, SURVEY.md §2.9): a low-overhead structured event stream
+you can tail in production and analyze offline, plus a metrics registry
+whose device-side values piggyback on the existing one-dispatch-behind
+metric reads (zero extra host syncs per window).
+
+* :class:`Recorder` / :func:`start` — thread-safe JSONL event stream
+  (step windows, dispatch gaps, loader stage/stall, loss-scale
+  skip/growth, retraces, per-psum collective bytes).
+* :class:`MetricsRegistry` — counters / gauges / reservoir-percentile
+  histograms; a strict no-op when disabled.
+* :func:`to_chrome_trace` — Chrome ``trace_event`` export (Perfetto).
+* Offline analysis: ``python -m apex_tpu.prof.timeline run.jsonl``.
+
+Instrumented subsystems discover the active recorder through
+:func:`get_recorder`; with none installed the hot paths reduce to one
+global read — the disabled path dispatches bit-identically to an
+uninstrumented build (``bench.py`` gates this).
+
+See ``docs/telemetry.md`` for the event schema and overhead model.
+"""
+
+from .events import (Recorder, get_recorder, set_recorder,  # noqa: F401
+                     start, to_chrome_trace)
+from .metrics import (Counter, Gauge, Histogram,            # noqa: F401
+                      MetricsRegistry)
+
+__all__ = ["Recorder", "get_recorder", "set_recorder", "start",
+           "to_chrome_trace", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry"]

@@ -1,0 +1,347 @@
+"""Structured event stream — the runtime half of the PyProf pillar.
+
+The reference's PyProf turns a live run into an analyzable record by
+pushing NVTX ranges into a CUPTI SQLite DB (``pyprof/nvtx`` +
+``pyprof/parse``).  The TPU-native equivalent cannot annotate from
+inside a compiled program, so the record is assembled at the HOST
+boundaries the runtime already crosses:
+
+* window dispatch + dispatch gap       (:class:`apex_tpu.runtime.StepPipeline`)
+* the one-dispatch-behind metric fetch (:class:`apex_tpu.runtime.DeferredMetrics`)
+* loader wait / device staging         (:class:`apex_tpu.data.PrefetchLoader`)
+* loss-scale skip/growth               (derived from the fetched metrics,
+  plus the imperative :class:`apex_tpu.amp.LossScaler` /
+  :class:`apex_tpu.optimizers.FusedOptimizer` paths)
+* retraces                             (jit tracing-cache growth, keyed by
+  the window's shape signature)
+* per-psum collective bytes            (recorded at TRACE time from the
+  static avals — zero runtime cost)
+
+:class:`Recorder` writes one JSON object per line (JSONL): ``tail -f``
+it in production, feed it to the offline analyzer
+(``python -m apex_tpu.prof.timeline run.jsonl``), or export a Chrome
+``trace_event`` file (:func:`to_chrome_trace`) for Perfetto /
+``chrome://tracing``.
+
+Overhead model: every event is one small dict + one ``json.dumps`` + one
+buffered write (~single-digit microseconds); the hot loop emits 2-3
+events per WINDOW (not per step) and the loader a couple per batch on
+its own threads.  With no recorder installed the instrumented call sites
+reduce to one global read returning ``None`` — the disabled path
+dispatches bit-identically to an uninstrumented build (gated by
+``bench.py`` self-validation).
+
+Usage::
+
+    from apex_tpu import telemetry
+
+    rec = telemetry.start("run.jsonl", example="imagenet")
+    ...             # StepPipeline / PrefetchLoader / amp pick it up
+    rec.close()     # writes the summary event
+
+or scoped: ``with telemetry.start(path): ...``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, IO, List, Optional, Union
+
+from .metrics import MetricsRegistry
+
+__all__ = ["Recorder", "get_recorder", "set_recorder", "start",
+           "to_chrome_trace"]
+
+_active: Optional["Recorder"] = None
+_active_lock = threading.Lock()
+
+
+def get_recorder() -> Optional["Recorder"]:
+    """The process-wide active recorder, or None when telemetry is off —
+    the ONE read every instrumented hot path pays when disabled."""
+    return _active
+
+
+def set_recorder(rec: Optional["Recorder"]) -> Optional["Recorder"]:
+    """Install (or clear, with None) the active recorder; returns the
+    previous one so scoped users can restore it."""
+    global _active
+    with _active_lock:
+        prev, _active = _active, rec
+    return prev
+
+
+def start(path: str, **meta) -> "Recorder":
+    """Open a recorder on ``path`` and install it as the active one.
+    Keyword args land in the stream's leading ``run`` event."""
+    rec = Recorder(path, meta=meta or None)
+    set_recorder(rec)
+    return rec
+
+
+def _json_default(x):
+    """Tolerant JSON encoding: numpy scalars/arrays and jax types show
+    up in metric dicts; never let an exotic leaf kill the stream."""
+    if hasattr(x, "item") and getattr(x, "ndim", None) == 0:
+        try:
+            return x.item()  # jaxlint: disable=J001 -- JSON encoding is the host boundary; values reaching the encoder were already fetched by the deferred reader
+        except Exception:
+            pass
+    if hasattr(x, "tolist"):
+        try:
+            return x.tolist()
+        except Exception:
+            pass
+    return repr(x)
+
+
+class Recorder:
+    """Thread-safe JSONL event sink + metrics registry for one run.
+
+    Every event is ``{"t": <seconds since the recorder opened>,
+    "kind": <str>, ...fields}``.  Event kinds and their schema are
+    documented in ``docs/telemetry.md`` (the table the analyzer and the
+    Chrome exporter are written against).
+
+    The recorder is a context manager (``close`` on exit, restoring the
+    previously active recorder if this one was active).  After
+    ``close()`` every ``event()`` is a silent no-op, so late producer
+    threads (loader workers draining) cannot crash shutdown.
+    """
+
+    def __init__(self, path_or_file: Union[str, IO], *,
+                 meta: Optional[dict] = None, reservoir: int = 512):
+        self._lock = threading.Lock()
+        if hasattr(path_or_file, "write"):
+            self._f, self._owns, self.path = path_or_file, False, None
+        else:
+            self._f = open(path_or_file, "w", encoding="utf-8")
+            self._owns, self.path = True, path_or_file
+        self._t0 = time.perf_counter()
+        self._closed = False
+        self._counts: Dict[str, int] = {}
+        #: host-side instruments, snapshotted into the ``summary`` event.
+        self.metrics = MetricsRegistry(reservoir=reservoir)
+        # observe_window_metrics state: _obs_hwm marks the highest step
+        # already observed (a re-fetched window — warmup drain + cadence
+        # print hit the same WindowMetrics twice — is tagged
+        # refetch=True, a real transfer but not new data); _scale_hwm
+        # guards the loss-scale derivation against the same doubling.
+        self._obs_hwm = 0
+        self._scale_hwm = 0
+        self._last_scale: Optional[float] = None
+        self.event("run", meta=meta or {})
+
+    # -- core sink ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return not self._closed
+
+    def now(self) -> float:
+        """Seconds since the recorder opened (the stream's clock)."""
+        return time.perf_counter() - self._t0
+
+    def event(self, kind: str, **fields) -> None:
+        """Append one event; silently dropped after ``close()``."""
+        if self._closed:
+            return
+        rec = {"t": round(self.now(), 6), "kind": kind}
+        rec.update(fields)
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line + "\n")
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+
+    @contextlib.contextmanager
+    def span(self, kind: str, **fields):
+        """Context manager emitting ``kind`` with a measured ``dur``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.event(kind, dur=round(time.perf_counter() - t0, 6),
+                       **fields)
+
+    # -- domain helpers -----------------------------------------------------
+    def observe_window_metrics(self, step: int, n_valid: int, values,
+                               fetch_s: float) -> None:
+        """Record one window's fetched metrics (called from
+        :meth:`apex_tpu.runtime.WindowMetrics.fetch` with HOST values —
+        the one-dispatch-behind read the loop already pays, so this adds
+        no host sync).  Emits a ``metrics`` event and derives ``scale``
+        skip/growth events with global step indices."""
+        import numpy as np
+
+        fields: Dict[str, Any] = {"step": step, "n_valid": n_valid,
+                                  "dur": round(fetch_s, 6)}
+        loss = scale = overflow = None
+        if isinstance(values, dict):
+            def _series(key):
+                v = values.get(key)
+                if v is None:
+                    return None
+                flat = np.ravel(np.asarray(v))
+                if flat.size == 0:
+                    return None
+                if flat.size < n_valid:     # per-window scalar metric
+                    flat = np.repeat(flat[-1], n_valid)
+                return [float(x) for x in flat[:n_valid]]
+            loss = _series("loss")
+            scale = _series("loss_scale")
+            overflow = _series("overflow")
+        if loss is not None:
+            fields["loss"] = [round(v, 6) for v in loss]
+            self.metrics.gauge("loss").set(loss[-1])
+        if scale is not None:
+            fields["loss_scale"] = scale
+            self.metrics.gauge("loss_scale").set(scale[-1])
+        if overflow is not None:
+            fields["skips"] = int(sum(1 for v in overflow if v))
+        if step + n_valid <= self._obs_hwm:
+            # A transfer genuinely happened (the histogram counts it),
+            # but the window was already observed — tag it so the
+            # analyzer and readers can discount the duplicate.
+            fields["refetch"] = True
+        self._obs_hwm = max(self._obs_hwm, step + n_valid)
+        self.metrics.histogram("metrics_fetch_s").observe(fetch_s)
+        self.event("metrics", **fields)
+        # Loss-scale trajectory events (skip on overflow, growth on the
+        # scale-window doubling), derived host-side from values already
+        # fetched.  Monotonic guard: a re-fetched window (warmup drain +
+        # cadence print hit the same WindowMetrics twice) derives nothing.
+        if scale is None or step + n_valid <= self._scale_hwm:
+            return
+        for j in range(n_valid):
+            gstep = step + j
+            if gstep < self._scale_hwm:
+                continue
+            s = scale[j]
+            if overflow is not None and overflow[j]:
+                self.metrics.counter("loss_scale_skips").inc()
+                self.event("scale", event="skip", step=gstep, scale=s)
+            elif self._last_scale is not None and s > self._last_scale:
+                self.event("scale", event="grow", step=gstep, scale=s)
+            self._last_scale = s
+        self._scale_hwm = step + n_valid
+
+    def note_collective(self, op: str, axis, nbytes: int, n: int,
+                        dtype: Optional[str] = None) -> None:
+        """Record one collective's per-invocation traffic.  Called at
+        TRACE time from ``parallel.reduce_gradients`` / ``zero1`` — the
+        byte counts are static aval properties, so instrumentation costs
+        nothing at run time and the event appears once per compile."""
+        fields = {"op": op,
+                  "axis": (list(axis) if isinstance(axis, (tuple, list))
+                           else axis),
+                  "bytes": int(nbytes), "n": int(n)}
+        if dtype is not None:
+            fields["dtype"] = dtype
+        self.event("collective", **fields)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self, *, loader_stats: Optional[dict] = None) -> None:
+        """Write the final ``summary`` event (registry snapshot + event
+        counts, plus an optional last ``loader`` snapshot) and close the
+        stream.  Idempotent."""
+        if self._closed:
+            return
+        if loader_stats:
+            self.event("loader", final=True, stats=dict(loader_stats))
+        self.event("summary", metrics=self.metrics.snapshot(),
+                   events=dict(self._counts))
+        with self._lock:
+            self._closed = True
+            try:
+                self._f.flush()
+                if self._owns:
+                    self._f.close()
+            except Exception:
+                pass
+        if get_recorder() is self:
+            set_recorder(None)
+
+    def __enter__(self) -> "Recorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- Chrome trace_event export ------------------------------------------------
+
+# Stream kinds -> synthetic thread rows of the Chrome trace.
+_CHROME_TIDS = {
+    "window": (1, "device-loop dispatch"),
+    "metrics": (2, "metric fetch (1 behind)"),
+    "loader_wait": (3, "consumer wait (loader)"),
+    "stage": (4, "device staging (H2D)"),
+    "opt_step": (5, "optimizer step"),
+}
+_CHROME_INSTANT = {"scale": 6, "retrace": 7, "collective": 8, "marker": 9}
+_CHROME_INSTANT_ROW = {6: "loss scale", 7: "retrace", 8: "collectives",
+                       9: "markers"}
+
+
+def _iter_events(events_or_path) -> List[dict]:
+    if isinstance(events_or_path, str):
+        out = []
+        with open(events_or_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue        # a torn tail line must not kill analysis
+        return out
+    return list(events_or_path)
+
+
+def to_chrome_trace(events_or_path, out_path: str) -> int:
+    """Convert a telemetry stream (path or loaded event list) into a
+    Chrome ``trace_event`` JSON file (load in Perfetto /
+    ``chrome://tracing``).  Durational events become complete ("X")
+    slices on per-subsystem rows; scale/retrace/collective/marker events
+    become instants.  Returns the number of trace events written."""
+    events = _iter_events(events_or_path)
+    out: List[dict] = []
+    for tid, name in sorted(
+            list(_CHROME_TIDS.values())
+            + [(t, n) for t, n in _CHROME_INSTANT_ROW.items()]):
+        out.append({"ph": "M", "pid": 0, "tid": tid,
+                    "name": "thread_name", "args": {"name": name}})
+    n = 0
+    for e in events:
+        kind = e.get("kind")
+        t_us = float(e.get("t", 0.0)) * 1e6
+        if kind in _CHROME_TIDS:
+            tid = _CHROME_TIDS[kind][0]
+            dur_us = float(e.get("dur", 0.0)) * 1e6
+            args = {k: v for k, v in e.items()
+                    if k not in ("t", "kind", "dur")}
+            name = kind
+            if kind == "window":
+                name = f"window@{e.get('step')}"
+            elif kind == "metrics":
+                name = f"fetch@{e.get('step')}"
+            out.append({"ph": "X", "pid": 0, "tid": tid, "name": name,
+                        "ts": t_us - dur_us, "dur": max(dur_us, 1.0),
+                        "args": args})
+            n += 1
+        elif kind in _CHROME_INSTANT:
+            args = {k: v for k, v in e.items() if k not in ("t", "kind")}
+            name = kind if kind != "scale" else \
+                f"scale:{e.get('event')}@{e.get('step')}"
+            out.append({"ph": "i", "pid": 0, "tid": _CHROME_INSTANT[kind],
+                        "name": name, "ts": t_us, "s": "t", "args": args})
+            n += 1
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": out,
+                   "displayTimeUnit": "ms"}, f)
+    return n

@@ -1,0 +1,231 @@
+"""Metrics registry — counters, gauges, and reservoir histograms.
+
+The host-side half of the run-telemetry engine (ISSUE 5): named
+instruments a run can bump cheaply from any thread, snapshotted once
+into the stream's final ``summary`` event (and on demand via
+:meth:`MetricsRegistry.snapshot`).
+
+Device-side values never enter this registry directly — they piggyback
+on the :class:`~apex_tpu.runtime.DeferredMetrics` one-dispatch-behind
+read (:meth:`apex_tpu.telemetry.Recorder.observe_window_metrics`), so
+enabling telemetry adds **zero** extra host syncs per window: the only
+device->host transfers are the ones the training loop already pays for
+its own metric prints.
+
+Histograms keep a bounded uniform reservoir (default 512 samples, the
+classic Vitter Algorithm R with a deterministic per-instrument RNG), so
+percentiles over million-step runs cost O(reservoir) memory and the
+same stream analyzed twice reports the same numbers.
+
+A registry built with ``enabled=False`` hands out shared no-op
+instruments: every ``inc``/``set``/``observe`` is a single attribute
+lookup plus a no-op call, so instrumented library code never needs an
+``if telemetry:`` guard of its own.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "nearest_rank_percentiles"]
+
+
+def nearest_rank_percentiles(samples: Sequence[float],
+                             qs: Sequence[float] = (50.0, 90.0, 99.0)
+                             ) -> List[Optional[float]]:
+    """Nearest-rank percentiles of a sample list ([] -> all None) — the
+    ONE percentile definition shared by :class:`Histogram` reservoirs
+    and the offline timeline analyzer, so in-run summaries and offline
+    reports can never diverge on interpolation."""
+    data = sorted(samples)
+    if not data:
+        return [None for _ in qs]
+    out = []
+    for q in qs:
+        idx = min(len(data) - 1,
+                  max(0, int(round(q / 100.0 * (len(data) - 1)))))
+        out.append(data[idx])
+    return out
+
+
+class Counter:
+    """Monotonic counter (events seen, batches delivered, skips fired)."""
+
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+    def snapshot(self):
+        return self._v
+
+
+class Gauge:
+    """Last-value-wins instrument (current loss scale, queue depth)."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self):
+        self._v: Optional[float] = None
+
+    def set(self, v) -> None:
+        self._v = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._v
+
+    def snapshot(self):
+        return self._v
+
+
+class Histogram:
+    """Streaming distribution with reservoir percentiles.
+
+    Exact ``count``/``sum``/``min``/``max``; percentiles come from a
+    bounded uniform reservoir (Algorithm R), so p50/p90/p99 over an
+    unbounded stream cost O(reservoir) memory.  The replacement RNG is
+    seeded per instrument — re-analyzing the same run reproduces the
+    same percentiles bit for bit.
+    """
+
+    __slots__ = ("_lock", "_res", "_cap", "_rng", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, reservoir: int = 512, seed: int = 0):
+        self._lock = threading.Lock()
+        self._res: List[float] = []
+        self._cap = max(1, int(reservoir))
+        self._rng = random.Random(seed)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._res) < self._cap:
+                self._res.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self._cap:
+                    self._res[j] = v
+
+    def percentiles(self, qs: Sequence[float] = (50.0, 90.0, 99.0)):
+        """Reservoir percentiles (nearest-rank); [] -> all None."""
+        with self._lock:
+            data = list(self._res)
+        return nearest_rank_percentiles(data, qs)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def snapshot(self):
+        p50, p90, p99 = self.percentiles((50.0, 90.0, 99.0))
+        return {"count": self.count,
+                "sum": round(self.sum, 6),
+                "min": self.min, "max": self.max,
+                "mean": (round(self.mean, 6)
+                         if self.count else None),
+                "p50": p50, "p90": p90, "p99": p99}
+
+
+class _NoopInstrument:
+    """Shared disabled instrument: accepts every instrument method as a
+    no-op, so disabled-registry call sites stay guard-free."""
+
+    __slots__ = ()
+    value = None
+    count = 0
+    mean = None
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v) -> None:
+        pass
+
+    def observe(self, v) -> None:
+        pass
+
+    def percentiles(self, qs=(50.0, 90.0, 99.0)):
+        return [None for _ in qs]
+
+    def snapshot(self):
+        return None
+
+
+_NOOP = _NoopInstrument()
+
+
+class MetricsRegistry:
+    """Named instrument factory + snapshot.
+
+    ``counter(name)`` / ``gauge(name)`` / ``histogram(name)`` create on
+    first use and return the same instrument afterwards (thread-safe).
+    ``enabled=False`` makes every accessor return the shared no-op
+    instrument — the strict-no-op contract of the disabled telemetry
+    path.
+    """
+
+    def __init__(self, enabled: bool = True, reservoir: int = 512):
+        self.enabled = bool(enabled)
+        self._reservoir = reservoir
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def _get(self, table, name: str, factory):
+        if not self.enabled:
+            return _NOOP
+        with self._lock:
+            inst = table.get(name)
+            if inst is None:
+                inst = table[name] = factory()
+            return inst
+
+    def counter(self, name: str):
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str):
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str):
+        # Deterministic per-name seed (crc32, not hash(): str hashing is
+        # salted per process): same run, same reservoir.
+        import zlib
+        return self._get(
+            self._hists, name,
+            lambda: Histogram(self._reservoir,
+                              seed=zlib.crc32(name.encode())))
+
+    def snapshot(self) -> dict:
+        """One nested dict of every instrument's current value."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        return {
+            "counters": {k: v.snapshot() for k, v in counters.items()},
+            "gauges": {k: v.snapshot() for k, v in gauges.items()},
+            "histograms": {k: v.snapshot() for k, v in hists.items()},
+        }

@@ -17,8 +17,11 @@ cd "$(dirname "$0")/.."
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # test_multi_tensor.py rides along for the flat-bucket matrix (ISSUE 4):
 # the bucket engine is pure XLA, so every degradation tier must keep its
-# numerics bit-identical.
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py -q"
+# numerics bit-identical.  test_telemetry.py rides along for the
+# run-telemetry matrix (ISSUE 5): the event stream is pure host Python,
+# so every tier must emit identical event shapes and keep the disabled
+# path a bitwise no-op.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

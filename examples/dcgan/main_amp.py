@@ -77,6 +77,10 @@ def parse():
                    help="run the reference-parity imperative amp surface "
                    "(amp.initialize num_losses=3 + scale_loss loss_id + "
                    "FusedAdam.step) instead of the pipelined runtime")
+    p.add_argument("--telemetry", type=str, default=None, metavar="PATH",
+                   help="record the run-telemetry event stream (JSONL) "
+                   "to PATH; analyze offline with "
+                   "python -m apex_tpu.prof.timeline PATH")
     return p.parse_args()
 
 
@@ -475,10 +479,27 @@ def main_imperative(opt):
 
 def main():
     opt = parse()
-    if opt.imperative:
-        main_imperative(opt)
-    else:
-        main_pipelined(opt)
+    rec = None
+    if opt.telemetry:
+        # Active recorder installed before either mode builds its loop:
+        # the pipelined path records window/gap/metrics events through
+        # StepPipeline; the imperative path records the per-step
+        # optimizer spans and deferred-overflow skip events.
+        from apex_tpu import telemetry
+        rec = telemetry.start(
+            opt.telemetry, example="dcgan",
+            mode="imperative" if opt.imperative else "pipelined",
+            opt_level=opt.opt_level, steps_per_call=opt.steps_per_call)
+    try:
+        if opt.imperative:
+            main_imperative(opt)
+        else:
+            main_pipelined(opt)
+    finally:
+        if rec is not None:
+            rec.close()
+            print(f"telemetry: {opt.telemetry} "
+                  f"(python -m apex_tpu.prof.timeline to analyze)")
 
 
 if __name__ == "__main__":

@@ -74,6 +74,10 @@ def parse():
                         "(apex_tpu.runtime.StepPipeline); host dispatch "
                         "and the metric fetch then cost once per N steps "
                         "— loss lines print one dispatch behind")
+    p.add_argument("--telemetry", type=str, default=None, metavar="PATH",
+                   help="record the run-telemetry event stream (JSONL) "
+                        "to PATH; analyze offline with "
+                        "python -m apex_tpu.prof.timeline PATH")
     return p.parse_args()
 
 
@@ -83,6 +87,27 @@ def main():
         raise SystemExit("only --synthetic data is implemented; pass "
                          "--synthetic (a real-data loader would plug in "
                          "here via apex_tpu.data)")
+    rec = None
+    if args.telemetry:
+        # Install the active recorder before the pipeline is built so
+        # StepPipeline and the deferred metric reads pick it up.
+        from apex_tpu import telemetry
+        rec = telemetry.start(args.telemetry, example="lm",
+                              opt_level=args.opt_level,
+                              attention=args.attention,
+                              steps_per_call=args.steps_per_call)
+    try:
+        # close() in finally: a diverged/killed run still flushes its
+        # stream and writes the summary event.
+        _train(args)
+    finally:
+        if rec is not None:
+            rec.close()
+            print(f"telemetry: {args.telemetry} "
+                  f"(python -m apex_tpu.prof.timeline to analyze)")
+
+
+def _train(args):
     loss_scale = args.loss_scale
     if loss_scale not in (None, "dynamic"):
         loss_scale = float(loss_scale)

@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J008 a known-bad snippet
+1. fixture self-tests — for every rule J001-J009 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -543,6 +543,96 @@ def test_j008_host_boundary_funcs_stay_exempt():
             return out
     """
     assert _codes(src) == []
+
+
+# -- J009: async-dispatch timing lies -----------------------------------------
+
+_J009_BAD = """
+import time
+import jax
+
+step = jax.jit(lambda s, b: s + b)
+
+def bench(state, batches):
+    t0 = time.perf_counter()
+    for b in batches:
+        state = step(state, b)
+    dt = time.perf_counter() - t0
+    return dt
+"""
+
+
+def test_j009_flags_unfenced_timing_of_jitted_call():
+    """The ISSUE-5 fixture: perf_counter around a jitted loop with no
+    sync in the span times ENQUEUE, not compute (the 6x-chip-peak bench
+    round-1 failure mode)."""
+    assert _codes(_J009_BAD, "examples/demo.py") == ["J009"]
+
+
+def test_j009_waiver_with_reason_passes():
+    waived = _J009_BAD.replace(
+        "    dt = time.perf_counter() - t0",
+        "    dt = time.perf_counter() - t0  "
+        "# jaxlint: disable=J009 -- fixture")
+    assert _codes(waived, "examples/demo.py") == []
+
+
+def test_j009_block_until_ready_fence_passes():
+    fixed = _J009_BAD.replace(
+        "    dt = time.perf_counter() - t0",
+        "    jax.block_until_ready(state)\n"
+        "    dt = time.perf_counter() - t0")
+    assert _codes(fixed, "examples/demo.py") == []
+
+
+def test_j009_value_fetch_fence_passes():
+    fixed = _J009_BAD.replace(
+        "    dt = time.perf_counter() - t0",
+        "    _ = float(state[0])\n"
+        "    dt = time.perf_counter() - t0")
+    assert _codes(fixed, "examples/demo.py") == []
+
+
+def test_j009_local_sync_helper_counts_as_fence():
+    """A call to a module-local helper that syncs internally (bench.py's
+    ``_force`` pattern) fences the timing — one-level interprocedural."""
+    fixed = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s, b: s + b)
+
+    def _force(x):
+        return float(jnp.ravel(x)[0])
+
+    def bench(state, batches):
+        t0 = time.perf_counter()
+        for b in batches:
+            state = step(state, b)
+        _force(state)
+        dt = time.perf_counter() - t0
+        return dt
+    """
+    assert _codes(fixed, "examples/demo.py") == []
+
+
+def test_j009_needs_a_jitted_call_between_clocks():
+    # plain host timing, and a jitted call outside the clock pair, pass
+    src = """
+    import time
+    import jax
+
+    step = jax.jit(lambda s: s)
+
+    def setup(state):
+        state = step(state)          # before the first clock read
+        t0 = time.perf_counter()
+        host_work()
+        dt = time.perf_counter() - t0
+        return state, dt
+    """
+    assert _codes(src, "examples/demo.py") == []
 
 
 # -- J000: waiver hygiene -----------------------------------------------------

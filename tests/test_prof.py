@@ -124,6 +124,94 @@ def test_dump_markers(tmp_path):
     assert lines[0]["kwargs"]["flag"]["value"] is False
 
 
+def test_capture_scope_annotate_nesting():
+    """ISSUE 5 satellite: nested annotate/scope/annotate must (a) nest
+    the named scopes into HLO metadata (the NVTX-range analog the
+    profiler trace shows) and (b) record one marker per annotated call
+    in call order."""
+    prof.MARKERS.clear()
+    prof.init()
+    try:
+        @prof.annotate("inner_op")
+        def inner(a):
+            return a * 2
+
+        @prof.annotate("outer_op")
+        def outer(a):
+            with prof.scope("mid"):
+                return inner(a) + 1
+
+        hlo = jax.jit(outer).lower(jnp.ones((4,))).compile().as_text()
+        assert "outer_op/mid/inner_op" in hlo, \
+            "named scopes must nest into HLO op metadata"
+        assert [m["op"] for m in prof.MARKERS] == ["outer_op", "inner_op"]
+        assert prof.MARKERS[0]["args"][0]["shape"] == (4,)
+    finally:
+        prof.init(enable_markers=False)
+
+
+def test_dump_markers_roundtrip():
+    """The dumped JSONL parses back into exactly the MARKERS content
+    (tuples arrive as lists — the JSON-normalized forms must match)."""
+    import json
+    import tempfile
+
+    prof.MARKERS.clear()
+    prof.init()
+    try:
+        @prof.annotate("round")
+        def f(a, mode="x"):
+            return a
+
+        f(jnp.ones((2, 3)), mode="y")
+        f(7, mode=None)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "markers.jsonl")
+            prof.dump_markers(path)
+            with open(path) as fh:
+                back = [json.loads(line) for line in fh]
+        want = [json.loads(json.dumps(m)) for m in prof.MARKERS]
+        assert back == want
+        assert back[0]["op"] == "round"
+        assert back[0]["kwargs"]["mode"]["value"] == "y"
+        assert back[1]["args"][0]["value"] == 7
+    finally:
+        prof.init(enable_markers=False)
+
+
+def test_annotate_emits_marker_into_telemetry_stream(tmp_path):
+    """ISSUE 5: with a telemetry recorder active, each annotate call
+    also lands a timestamped ``marker`` event in the run's stream (the
+    traceMarker dicts become tail-able run events)."""
+    import json
+
+    from apex_tpu import telemetry
+
+    prof.MARKERS.clear()
+    prof.init()
+    path = str(tmp_path / "run.jsonl")
+    rec = telemetry.start(path)
+    try:
+        @prof.annotate("tele_op")
+        def f(a):
+            return a + 1
+
+        f(jnp.ones((2,)))
+    finally:
+        rec.close()
+        prof.init(enable_markers=False)
+    with open(path) as fh:
+        events = [json.loads(line) for line in fh]
+    markers = [e for e in events if e["kind"] == "marker"]
+    assert len(markers) == 1
+    assert markers[0]["op"] == "tele_op"
+    assert markers[0]["args"][0]["shape"] == [2]
+    assert markers[0]["t"] >= 0
+    # and the in-memory MARKERS list still got its copy (dump_markers
+    # and the stream describe the same call)
+    assert prof.MARKERS[0]["op"] == "tele_op"
+
+
 # -- measured-trace parse stage (VERDICT r2 #6) -------------------------------
 
 @pytest.mark.slow

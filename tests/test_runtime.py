@@ -136,6 +136,40 @@ def test_deferred_metrics_one_dispatch_behind():
     np.testing.assert_allclose(host["loss"], 1.0)
 
 
+@pytest.mark.parametrize("n_windows", [1, 2, 5])
+def test_deferred_metrics_flush_drops_no_window(n_windows):
+    """ISSUE 5 satellite regression: every pushed window is handed back
+    exactly once across push() returns + one flush() — the final
+    in-flight window (which push alone never returns) is not silently
+    dropped at loop exit."""
+    reader = runtime.DeferredMetrics()
+    returned = []
+    for i in range(n_windows):
+        prev = reader.push({"loss": jnp.float32(i)}, 4)
+        if prev is not None:
+            returned.append(prev.step)
+    flushed = reader.flush()
+    returned += [wm.step for wm in flushed]
+    assert returned == [4 * i for i in range(n_windows)]
+    assert reader.flush() == []        # idempotent until the next push
+    # the flushed handles fetch like any other window
+    np.testing.assert_allclose(flushed[-1].fetch()["loss"],
+                               n_windows - 1)
+
+
+def test_deferred_metrics_flush_empty_and_run_drains():
+    assert runtime.DeferredMetrics().flush() == []
+    # StepPipeline.run drains through flush: on_metrics sees EVERY window
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.1),
+                                       opt_level="O0")
+    pipe = runtime.StepPipeline(step_fn, k=2)
+    seen = []
+    pipe.run(init_fn(_params()),
+             runtime.window_batches(iter(_batches(6)), 2),
+             on_metrics=lambda wm: seen.append(wm.step))
+    assert seen == [0, 2, 4]
+
+
 def test_window_batches_pad_and_drop():
     batches = [(np.full((2,), i, np.float32),) for i in range(5)]
     padded = list(runtime.window_batches(iter(batches), 2))

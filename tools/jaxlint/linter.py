@@ -1,6 +1,6 @@
 """jaxlint core — AST rules, waiver handling, and the lint engine.
 
-Eight rules tuned to this codebase's failure modes (the ones that are
+Nine rules tuned to this codebase's failure modes (the ones that are
 invisible to pytest and surface as 10x dispatch-floor regressions in
 ``bench.py``):
 
@@ -46,6 +46,17 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   reduce per bucket with a ``BucketStore``) and fetch ONE value, or
   stack the per-leaf values into a single transfer (ISSUE 4: the
   tree-sweep twin of the J001 stalls).
+* **J009** async-dispatch timing lies: ``time.time()`` /
+  ``time.perf_counter()`` read before AND after a call to a jitted
+  callable with **no sync in the timed span** — jax dispatch is
+  asynchronous, so the elapsed time measures how fast the host can
+  *enqueue* the program, not how long the device takes to run it
+  (bench round 1 reported 6x chip peak exactly this way).  Fence the
+  measurement with ``jax.block_until_ready(out)`` or a value fetch
+  (``device_get`` / ``float()`` on an output) before reading the
+  second clock; calls to local helpers that sync internally count
+  (ISSUE 5: the static twin of the telemetry stream's measured-window
+  contract).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -81,6 +92,9 @@ RULES: Dict[str, str] = {
     "J008": "per-leaf host sync in a loop over tree_leaves/tree_flatten "
             "(O(leaves) round-trips; reduce on device or batch into one "
             "transfer)",
+    "J009": "wall-clock timing around a jitted call with no sync in the "
+            "timed span (async dispatch: the clock measures enqueue, not "
+            "compute)",
 }
 
 # Functions whose *contract* is the host boundary: serialization must
@@ -333,6 +347,28 @@ def _is_jax_jit(func: ast.AST) -> bool:
     return _dotted(func) in ("jax.jit", "jit", "pjit", "jax.pjit")
 
 
+# Calls that fence async dispatch for J009: a device round-trip or an
+# explicit block.  ``float()/int()/bool()`` and ``.fetch()``/``.item()``
+# are counted generously (regardless of arg arrayishness) — precision
+# over recall on the TIMING rule means missing a pathological
+# ``float(python_scalar)`` fence, not flagging a correctly fenced loop.
+_J009_SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready",
+                     "np.asarray", "numpy.asarray", "np.array",
+                     "numpy.array"}
+
+
+def _is_sync_call(call: ast.Call) -> bool:
+    if _dotted(call.func) in _J009_SYNC_DOTTED:
+        return True
+    if isinstance(call.func, ast.Attribute) and call.func.attr in (
+            "item", "block_until_ready", "fetch", "last"):
+        return True
+    if isinstance(call.func, ast.Name) \
+            and call.func.id in ("float", "int", "bool") and call.args:
+        return True
+    return False
+
+
 # -- module-level scan: jit sites, donated names, function defs ---------------
 
 class _ModuleIndex:
@@ -357,6 +393,22 @@ class _ModuleIndex:
     def jitted_name(self, scope, name: str) -> bool:
         return (scope, name) in self.jitted_names \
             or (None, name) in self.jitted_names
+
+    def sync_defs(self) -> Set[str]:
+        """Names of module-level defs whose body directly syncs — calling
+        one (e.g. a local ``_force``/``drain`` helper) fences an
+        async-dispatch timing exactly like an inline ``device_get``, so
+        J009 treats it as a sync point (one-level interprocedural)."""
+        cached = getattr(self, "_sync_defs", None)
+        if cached is None:
+            cached = set()
+            for name, fn in self.defs.items():
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call) and _is_sync_call(sub):
+                        cached.add(name)
+                        break
+            self._sync_defs = cached
+        return cached
 
     def donated_argnums(self, scope, name: str) -> Optional[Set[int]]:
         got = self.donated.get((scope, name))
@@ -648,8 +700,15 @@ class _ScopeWalker:
         self.leafish: Set[str] = set()
         self.jit_scoped = (fn is not None
                            and fn.name in self.idx.jitted_defs)
+        # J009 collection: clock reads, jitted-call sites, and sync
+        # points seen in this scope (line-ordered pairing happens in
+        # _finish_j009 once the whole scope is walked).
+        self._j009_clocks: List[Tuple[int, int]] = []
+        self._j009_jits: List[Tuple[int, str]] = []
+        self._j009_syncs: List[int] = []
         self._stmts(body, loop_depth=0, loop_vars=frozenset(),
                     leaf_loop=False)
+        self._finish_j009()
 
     def _stmts(self, body: List[ast.stmt], loop_depth: int,
                loop_vars: frozenset, leaf_loop: bool) -> None:
@@ -826,6 +885,7 @@ class _ScopeWalker:
                         self._check_j001_call(sub, loop_depth, leaf_loop)
                         self._check_j004_call(sub, loop_depth, loop_vars)
                         self._check_j007_call(sub, loop_depth)
+                        self._collect_j009(sub)
         # While tests live on the stmt itself
         if isinstance(stmt, ast.While):
             self._check_j006(stmt)
@@ -909,6 +969,56 @@ class _ScopeWalker:
             f"host->device staging belongs in the input engine "
             f"(PrefetchLoader / stage_windows device=...), where it "
             f"overlaps compute instead of serializing with each step"))
+
+    # .. J009 .................................................................
+
+    _J009_CLOCK_CALLS = ("time.time", "time.perf_counter",
+                         "time.monotonic", "perf_counter", "monotonic",
+                         "timeit.default_timer", "default_timer")
+
+    def _collect_j009(self, call: ast.Call) -> None:
+        """Classify one call for the scope-level timing analysis: a
+        clock read, a sync point (inline or via a local helper that
+        syncs), or a call to a known-jitted callable."""
+        if _dotted(call.func) in self._J009_CLOCK_CALLS:
+            self._j009_clocks.append((call.lineno, call.col_offset))
+            return
+        if _is_sync_call(call) or (
+                isinstance(call.func, ast.Name)
+                and call.func.id in self.idx.sync_defs()):
+            self._j009_syncs.append(call.lineno)
+            return
+        if isinstance(call.func, ast.Name) \
+                and self.idx.jitted_name(self.fn, call.func.id):
+            self._j009_jits.append((call.lineno, call.func.id))
+
+    def _finish_j009(self) -> None:
+        """Pair clock reads around jitted calls: a jitted call between
+        two clock reads with no sync inside the span means the elapsed
+        time measures ENQUEUE, not compute (async dispatch).  Reported
+        at the closing clock read; one finding per scope."""
+        if len(self._j009_clocks) < 2 or not self._j009_jits:
+            return
+        clocks = sorted(self._j009_clocks)
+        syncs = sorted(self._j009_syncs)
+        for j_line, j_name in sorted(self._j009_jits):
+            before = [c for c in clocks if c[0] < j_line]
+            after = [c for c in clocks if c[0] > j_line]
+            if not before or not after:
+                continue
+            t_open, t_close = before[-1], after[0]
+            if any(t_open[0] < s <= t_close[0] for s in syncs):
+                continue
+            self.findings.append(Finding(
+                self.path, t_close[0], t_close[1], "J009",
+                f"wall-clock timing around jitted '{j_name}' with no "
+                f"block_until_ready/device_get/value fetch in the timed "
+                f"span — jax dispatch is async, so this elapsed time "
+                f"measures how fast the host ENQUEUED the program, not "
+                f"how long the device ran it; fence with "
+                f"jax.block_until_ready(out) or fetch a value before "
+                f"reading the second clock"))
+            return
 
     # .. J004 .................................................................
 
