@@ -21,7 +21,20 @@ analyzer :mod:`apex_tpu.prof.timeline` (``python -m
 apex_tpu.prof.timeline run.jsonl``), which distills the structured
 event streams :mod:`apex_tpu.telemetry` records into step-time
 percentiles, stall/gap attribution, the loss-scale trajectory, retrace
-reports, and per-collective byte totals.
+reports, watchdog alerts, and per-collective byte totals.
+
+ISSUE 6 closes the attribution loop with two more runnable stages (like
+``timeline``, deliberately NOT imported here — ``python -m`` would trip
+runpy's double-import warning; import them explicitly):
+
+* :mod:`apex_tpu.prof.roofline` — per-region FLOP/byte harvest at trace
+  time (``jit(...).lower().cost_analysis()`` with a jaxpr-walk fallback)
+  joined with measured step times into an MFU ledger: achieved FLOP/s,
+  compute-vs-memory boundedness against measured peaks, and
+  steady-vs-best-window gap attribution.
+* :mod:`apex_tpu.prof.regress` — ``python -m apex_tpu.prof.regress
+  base.json cur.json`` diffs two timeline/bench summaries with
+  per-metric tolerances and exits non-zero on regressions (the CI gate).
 """
 
 from .analysis import OpRecord, Profile, profile_function   # noqa: F401

@@ -22,12 +22,63 @@ from __future__ import annotations
 import contextlib
 import functools
 import json
+import re
 from typing import Any, Callable, List
 
 import jax
 
 MARKERS: List[dict] = []
 _enabled = False
+
+# Transform wrappers jax folds AROUND user scope names in the jaxpr's
+# name stack: a forward scope ``blockA`` reappears in the backward pass
+# as ``jvp(blockA)`` / ``transpose(jvp(blockA))``, and call machinery
+# contributes bare components like ``pjit``/``scan``.  Region
+# attribution (``prof.roofline``) must see ONE region for fwd+bwd, so
+# these are peeled/dropped by :func:`region_path`.
+_TRANSFORM_WRAP_RE = re.compile(
+    r"^(?:jit|pjit|jvp|vjp|transpose|vmap|pmap|remat|checkpoint|rematted"
+    r"|custom_[a-z_]+|named)\((.*)\)$")
+# Bare call-machinery components are dropped by EXACT match — a user
+# region that merely starts with one of these names ('branch2a',
+# 'body_net', 'scanner') must survive (review finding); only the
+# 'custom_*' family is a genuine prefix.
+_TRANSFORM_BARE = frozenset(
+    ("jit", "pjit", "jvp", "vjp", "transpose", "vmap", "pmap", "scan",
+     "while", "cond", "remat", "checkpoint", "rematted", "named", "body",
+     "branch", "branches"))
+
+
+def _peel(component: str) -> str:
+    """Strip transform wrappers off one name-stack component:
+    ``transpose(jvp(blockA))`` -> ``blockA``; a bare transform name
+    (``pjit``, ``scan``) peels to the empty string."""
+    prev = None
+    while prev != component:
+        prev = component
+        m = _TRANSFORM_WRAP_RE.match(component)
+        if m:
+            component = m.group(1)
+    if component in _TRANSFORM_BARE or component.startswith("custom_"):
+        return ""
+    return component
+
+
+def region_path(scope: str, depth: int = 1) -> str:
+    """Collapse a jaxpr scope / name-stack path to its leading ``depth``
+    USER region components — the :func:`scope`/:func:`annotate` names,
+    with jax's transform wrappers peeled so forward and backward ops of
+    one region land in the same row (``transpose(jvp(blockA))/mm1`` and
+    ``blockA/mm1`` both map to ``blockA`` at depth 1, ``blockA/mm1`` at
+    depth 2).  Ops outside any user scope map to ``<unattributed>``."""
+    parts = []
+    for p in scope.split("/"):
+        p = _peel(p.strip())
+        if p:
+            parts.append(p)
+    if not parts:
+        return "<unattributed>"
+    return "/".join(parts[:max(1, depth)])
 
 
 def init(enable_markers: bool = True) -> None:
@@ -51,7 +102,16 @@ def _arg_marker(fn_name: str, args, kwargs) -> dict:
 
 @contextlib.contextmanager
 def scope(name: str):
-    """Named scope context; name lands in HLO metadata / profiler traces."""
+    """Named scope context; name lands in HLO metadata / profiler traces
+    (and, after :func:`init`, as a ``marker`` event in an active
+    telemetry stream — same contract as :func:`annotate`).  These names
+    are the region keys :mod:`apex_tpu.prof.roofline` attributes
+    harvested FLOPs/bytes to (see :func:`region_path`)."""
+    if _enabled:
+        from .. import telemetry as _telemetry
+        rec = _telemetry.get_recorder()
+        if rec is not None:
+            rec.event("marker", op=name, args=[], kwargs={})
     with jax.named_scope(name):
         yield
 

@@ -40,7 +40,12 @@ import jax.numpy as jnp
 
 from .analysis import profile_function
 
-_COMPUTE_OPS = ("conv_general_dilated", "dot_general")
+#: the MXU-eligible primitives: FLOPs charged to these are "matmul
+#: FLOPs" everywhere downstream (this ledger's compute rows, and the
+#: roofline engine's MFU numerator — ``prof.roofline`` imports this so
+#: the two attributions can never disagree on what counts as math).
+COMPUTE_OPS = ("conv_general_dilated", "dot_general")
+_COMPUTE_OPS = COMPUTE_OPS
 
 # Optimizer-side bytes per parameter ELEMENT for the O2 momentum-SGD /
 # master-weights contract, beyond what conv/dot operands already count:

@@ -40,7 +40,39 @@ import json
 import sys
 from typing import Any, Dict, List, Optional, Sequence
 
-__all__ = ["load_events", "analyze", "format_report", "main"]
+__all__ = ["SCHEMA_VERSION", "load_events", "analyze", "format_report",
+           "check_schema_version", "main"]
+
+#: Version of the ANALYSIS dict this module emits (``analyze()`` /
+#: ``--json``).  ``major.minor``: the major bumps only when an existing
+#: field changes meaning or disappears; adding fields bumps the minor.
+#: ``prof.regress`` diffs two analyses across commits, so it refuses
+#: inputs whose major it does not understand (see
+#: :func:`check_schema_version`) instead of silently comparing
+#: incompatible numbers.
+SCHEMA_VERSION = "1.0"
+
+
+def check_schema_version(obj: Dict[str, Any], where: str = "input") -> None:
+    """Reject an analysis dict from a FUTURE schema major with a clear
+    error (old majors and missing versions pass — forward tools must
+    read old artifacts, old tools must not misread new ones)."""
+    ver = obj.get("schema_version")
+    if ver is None:
+        return
+    try:
+        major = int(str(ver).split(".")[0])
+    except (ValueError, AttributeError):
+        raise ValueError(
+            f"{where}: unparseable schema_version {ver!r} "
+            f"(expected 'major.minor', e.g. {SCHEMA_VERSION!r})")
+    supported = int(SCHEMA_VERSION.split(".")[0])
+    if major > supported:
+        raise ValueError(
+            f"{where}: schema_version {ver} is a FUTURE major (this "
+            f"analyzer understands <= {supported}.x) — regenerate the "
+            f"summary with this repo's `python -m apex_tpu.prof.timeline "
+            f"--json`, or upgrade apex_tpu to diff it")
 
 
 def load_events(path: str) -> List[dict]:
@@ -73,7 +105,10 @@ def analyze(events: List[dict]) -> Dict[str, Any]:
     summary = next((e for e in events if e.get("kind") == "summary"), None)
     run_ev = next((e for e in events if e.get("kind") == "run"), None)
 
+    alert_ev = [e for e in events if e.get("kind") == "alert"]
+
     out: Dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
         "meta": (run_ev or {}).get("meta", {}),
         "n_events": len(events),
     }
@@ -160,6 +195,23 @@ def analyze(events: List[dict]) -> Dict[str, Any]:
         "respecializations": len(respecs),
         "retraces": len(true_retraces),
         "by_signature": sorted({str(e.get("sig")) for e in true_retraces}),
+        # host seconds spent inside dispatches that grew the jit cache
+        # (each retrace event carries its dispatch's dur) — the compile
+        # share of the steady-vs-best gap the roofline ledger reports.
+        "compile_s": round(sum(float(e.get("dur", 0.0))
+                               for e in retrace_ev), 4),
+    }
+
+    # -- watchdog alerts ------------------------------------------------------
+    by_rule: Dict[str, int] = {}
+    for e in alert_ev:
+        rule = str(e.get("rule", "?"))
+        by_rule[rule] = by_rule.get(rule, 0) + 1
+    out["alerts"] = {
+        "total": len(alert_ev),
+        "by_rule": by_rule,
+        "steps": sorted({int(e["step"]) for e in alert_ev
+                         if e.get("step") is not None})[:64],
     }
 
     # -- collectives --------------------------------------------------------
@@ -251,8 +303,17 @@ def format_report(a: Dict[str, Any]) -> str:
     lines.append(f"compiles: {rt.get('compiles', 0)}  "
                  f"re-specializations: {rt.get('respecializations', 0)}  "
                  f"retraces: {rt.get('retraces', 0)}"
+                 + (f"  ({rt['compile_s']}s compiling)"
+                    if rt.get("compile_s") else "")
                  + (f"  signatures: {rt['by_signature']}"
                     if rt.get("retraces") else ""))
+    al = a.get("alerts") or {}
+    if al.get("total"):
+        rules = ", ".join(f"{k} x{v}"
+                          for k, v in sorted(al["by_rule"].items()))
+        lines.append(f"health: {al['total']} watchdog alert(s) ({rules})"
+                     + (f" at steps {al['steps'][:8]}"
+                        if al.get("steps") else ""))
     co = a.get("collectives") or {}
     if co.get("by_op"):
         lines.append(f"collectives: "

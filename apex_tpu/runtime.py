@@ -185,7 +185,7 @@ class StepPipeline:
         out = self._dispatch(loop, state, window, valid)
         t1 = time.perf_counter()
         self._t_last_dispatch = t1
-        self._note_retrace(rec, loop, program, window, step0)
+        self._note_retrace(rec, loop, program, window, step0, dur=t1 - t0)
         # dur is the host DISPATCH time (async — the device may still be
         # running); gap is host time since the previous dispatch returned
         # (metric fetches, loader waits, python glue).
@@ -198,7 +198,7 @@ class StepPipeline:
         return out
 
     def _note_retrace(self, rec, loop, program: str, window,
-                      step0: int) -> None:
+                      step0: int, dur: float = 0.0) -> None:
         """Emit a ``retrace`` event when this dispatch grew the jit
         tracing cache, keyed by the window's shape signature (one int
         compare per dispatch; the signature is only built on growth).
@@ -209,7 +209,13 @@ class StepPipeline:
         call-1 re-specialization, where jit re-caches on the donated
         state's returned sharding with the SAME signature.  Only
         not-first + new-sig growth increments the ``retraces`` counter
-        the analyzer and bench gate on."""
+        the analyzer and bench gate on.
+
+        ``dur`` is the dispatch duration of the call that grew the
+        cache — trace+compile time plus the enqueue, i.e. the compile
+        share of the steady-vs-best-window gap.  The timeline analyzer
+        sums it into ``retraces.compile_s`` and the roofline ledger's
+        gap attribution reads it (ISSUE 6)."""
         try:
             size = loop._cache_size()
         except Exception:
@@ -226,7 +232,7 @@ class StepPipeline:
         self._sigs_seen[program].add(sig)
         rec.event("retrace", program=program, step=step0,
                   n_traces=size, first=(prev == 0), new_sig=new_sig,
-                  sig=sig)
+                  sig=sig, dur=round(dur, 6))
         if prev > 0 and new_sig:
             rec.metrics.counter("retraces").inc()
 

@@ -11,8 +11,13 @@ metric reads (zero extra host syncs per window).
   skip/growth, retraces, per-psum collective bytes).
 * :class:`MetricsRegistry` — counters / gauges / reservoir-percentile
   histograms; a strict no-op when disabled.
+* :class:`Watchdog` (:mod:`~apex_tpu.telemetry.watchdog`) — run-health
+  rule engine folding events online into debounced ``alert`` events
+  (non-finite loss, loss-scale collapse, loader-stall spikes, step-time
+  anomalies, retrace storms); ``telemetry.start(path, watchdog=True)``.
 * :func:`to_chrome_trace` — Chrome ``trace_event`` export (Perfetto).
-* Offline analysis: ``python -m apex_tpu.prof.timeline run.jsonl``.
+* Offline analysis: ``python -m apex_tpu.prof.timeline run.jsonl``;
+  cross-run regression diffing: ``python -m apex_tpu.prof.regress``.
 
 Instrumented subsystems discover the active recorder through
 :func:`get_recorder`; with none installed the hot paths reduce to one
@@ -25,8 +30,9 @@ See ``docs/telemetry.md`` for the event schema and overhead model.
 from .events import (Recorder, get_recorder, set_recorder,  # noqa: F401
                      start, to_chrome_trace)
 from .metrics import (Counter, Gauge, Histogram,            # noqa: F401
-                      MetricsRegistry)
+                      MetricsRegistry, Rolling)
+from .watchdog import Watchdog                              # noqa: F401
 
 __all__ = ["Recorder", "get_recorder", "set_recorder", "start",
            "to_chrome_trace", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry"]
+           "MetricsRegistry", "Rolling", "Watchdog"]

@@ -74,10 +74,19 @@ def set_recorder(rec: Optional["Recorder"]) -> Optional["Recorder"]:
     return prev
 
 
-def start(path: str, **meta) -> "Recorder":
+def start(path: str, watchdog: bool = False, **meta) -> "Recorder":
     """Open a recorder on ``path`` and install it as the active one.
-    Keyword args land in the stream's leading ``run`` event."""
+    Keyword args land in the stream's leading ``run`` event.
+
+    ``watchdog=True`` also attaches the run-health rule engine
+    (:mod:`apex_tpu.telemetry.watchdog`): events are folded online on
+    the emitting thread and debounced ``alert`` events land in the same
+    stream; read ``rec.watchdog.format_line()`` at exit for the
+    one-line health summary."""
     rec = Recorder(path, meta=meta or None)
+    if watchdog:
+        from .watchdog import attach
+        attach(rec)
     set_recorder(rec)
     return rec
 
@@ -133,6 +142,8 @@ class Recorder:
         self._obs_hwm = 0
         self._scale_hwm = 0
         self._last_scale: Optional[float] = None
+        #: optional run-health rule engine (attach_watchdog / watchdog.attach)
+        self._watchdog = None
         self.event("run", meta=meta or {})
 
     # -- core sink ----------------------------------------------------------
@@ -156,6 +167,25 @@ class Recorder:
                 return
             self._f.write(line + "\n")
             self._counts[kind] = self._counts.get(kind, 0) + 1
+        # Watchdog fold (ISSUE 6): outside the stream lock, on THIS
+        # thread — the event dict already exists, so the rules cost a
+        # few dict reads and no device work.  Alerts the fold emits come
+        # back through event() with kind="alert" and are not re-folded.
+        wd = self._watchdog
+        if wd is not None and kind != "alert":
+            wd.observe(rec)
+
+    def attach_watchdog(self, watchdog) -> None:
+        """Install a run-health watchdog
+        (:class:`apex_tpu.telemetry.watchdog.Watchdog`): every event
+        written from now on is folded through its rules, and the final
+        ``summary`` event carries its ``health`` verdict."""
+        self._watchdog = watchdog
+
+    @property
+    def watchdog(self):
+        """The attached watchdog, or None."""
+        return self._watchdog
 
     @contextlib.contextmanager
     def span(self, kind: str, **fields):
@@ -252,8 +282,10 @@ class Recorder:
             return
         if loader_stats:
             self.event("loader", final=True, stats=dict(loader_stats))
-        self.event("summary", metrics=self.metrics.snapshot(),
-                   events=dict(self._counts))
+        summary_fields = {"metrics": self.metrics.snapshot()}
+        if self._watchdog is not None:
+            summary_fields["health"] = self._watchdog.health()
+        self.event("summary", events=dict(self._counts), **summary_fields)
         with self._lock:
             self._closed = True
             try:

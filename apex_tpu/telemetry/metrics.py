@@ -29,7 +29,7 @@ import random
 import threading
 from typing import Dict, List, Optional, Sequence
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Rolling",
            "nearest_rank_percentiles"]
 
 
@@ -146,6 +146,55 @@ class Histogram:
                 "mean": (round(self.mean, 6)
                          if self.count else None),
                 "p50": p50, "p90": p90, "p99": p99}
+
+
+class Rolling:
+    """Fixed-window rolling statistics — the last ``window``
+    observations only.
+
+    The :mod:`~apex_tpu.telemetry.watchdog` anomaly rules compare each
+    fresh sample against a ROLLING baseline (median of the recent past),
+    which a :class:`Histogram` reservoir cannot provide: a reservoir
+    remembers the whole run, so a step-time regression an hour in would
+    be judged against hour-old samples and never look anomalous.  Median
+    (not mean) so the compile-sized outliers that seed the window do not
+    drag the baseline."""
+
+    __slots__ = ("_buf", "_cap", "_idx", "count")
+
+    def __init__(self, window: int = 32):
+        self._buf: List[float] = []
+        self._cap = max(1, int(window))
+        self._idx = 0
+        self.count = 0
+
+    def observe(self, v) -> None:
+        v = float(v)
+        self.count += 1
+        if len(self._buf) < self._cap:
+            self._buf.append(v)
+        else:
+            self._buf[self._idx] = v
+            self._idx = (self._idx + 1) % self._cap
+    # NOTE: single-consumer by design (the watchdog folds on whichever
+    # thread emitted the event, under the Watchdog lock) — no lock here.
+
+    @property
+    def full(self) -> bool:
+        return len(self._buf) >= self._cap
+
+    def median(self) -> Optional[float]:
+        if not self._buf:
+            return None
+        return nearest_rank_percentiles(self._buf, (50.0,))[0]
+
+    @property
+    def mean(self) -> Optional[float]:
+        return sum(self._buf) / len(self._buf) if self._buf else None
+
+    @property
+    def total(self) -> float:
+        return sum(self._buf)
 
 
 class _NoopInstrument:

@@ -20,8 +20,11 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # numerics bit-identical.  test_telemetry.py rides along for the
 # run-telemetry matrix (ISSUE 5): the event stream is pure host Python,
 # so every tier must emit identical event shapes and keep the disabled
-# path a bitwise no-op.
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py -q"
+# path a bitwise no-op.  test_roofline.py + test_watchdog.py ride along
+# for the attribution/health engines (ISSUE 6): cost harvesting is a
+# static jaxpr walk and the watchdog a pure host fold, so every tier
+# must produce identical ledgers/alerts.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

@@ -81,6 +81,12 @@ def parse():
                    help="record the run-telemetry event stream (JSONL) "
                    "to PATH; analyze offline with "
                    "python -m apex_tpu.prof.timeline PATH")
+    p.add_argument("--watchdog", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="run-health rule engine over the telemetry "
+                   "events (debounced alerts + a health: line at exit); "
+                   "ON by default when --telemetry is set, "
+                   "--no-watchdog disables")
     return p.parse_args()
 
 
@@ -480,14 +486,18 @@ def main_imperative(opt):
 def main():
     opt = parse()
     rec = None
-    if opt.telemetry:
+    use_watchdog = (opt.watchdog if opt.watchdog is not None
+                    else bool(opt.telemetry))
+    if opt.telemetry or use_watchdog:
         # Active recorder installed before either mode builds its loop:
         # the pipelined path records window/gap/metrics events through
         # StepPipeline; the imperative path records the per-step
-        # optimizer spans and deferred-overflow skip events.
+        # optimizer spans and deferred-overflow skip events.  The
+        # watchdog (default-on under --telemetry) folds them online.
         from apex_tpu import telemetry
         rec = telemetry.start(
-            opt.telemetry, example="dcgan",
+            opt.telemetry or _os.devnull, watchdog=use_watchdog,
+            example="dcgan",
             mode="imperative" if opt.imperative else "pipelined",
             opt_level=opt.opt_level, steps_per_call=opt.steps_per_call)
     try:
@@ -497,9 +507,13 @@ def main():
             main_pipelined(opt)
     finally:
         if rec is not None:
+            wd = rec.watchdog
             rec.close()
-            print(f"telemetry: {opt.telemetry} "
-                  f"(python -m apex_tpu.prof.timeline to analyze)")
+            if opt.telemetry:
+                print(f"telemetry: {opt.telemetry} "
+                      f"(python -m apex_tpu.prof.timeline to analyze)")
+            if wd is not None:
+                print(f"health: {wd.format_line()}")
 
 
 if __name__ == "__main__":

@@ -78,6 +78,12 @@ def parse():
                    help="record the run-telemetry event stream (JSONL) "
                         "to PATH; analyze offline with "
                         "python -m apex_tpu.prof.timeline PATH")
+    p.add_argument("--watchdog", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="run-health rule engine over the telemetry "
+                        "events (debounced alerts + a health: line at "
+                        "exit); ON by default when --telemetry is set, "
+                        "--no-watchdog disables")
     return p.parse_args()
 
 
@@ -88,23 +94,30 @@ def main():
                          "--synthetic (a real-data loader would plug in "
                          "here via apex_tpu.data)")
     rec = None
-    if args.telemetry:
+    use_watchdog = (args.watchdog if args.watchdog is not None
+                    else bool(args.telemetry))
+    if args.telemetry or use_watchdog:
         # Install the active recorder before the pipeline is built so
         # StepPipeline and the deferred metric reads pick it up.
         from apex_tpu import telemetry
-        rec = telemetry.start(args.telemetry, example="lm",
+        rec = telemetry.start(args.telemetry or _os.devnull,
+                              watchdog=use_watchdog, example="lm",
                               opt_level=args.opt_level,
                               attention=args.attention,
                               steps_per_call=args.steps_per_call)
     try:
         # close() in finally: a diverged/killed run still flushes its
-        # stream and writes the summary event.
+        # stream, the summary event, and the watchdog's final alerts.
         _train(args)
     finally:
         if rec is not None:
+            wd = rec.watchdog
             rec.close()
-            print(f"telemetry: {args.telemetry} "
-                  f"(python -m apex_tpu.prof.timeline to analyze)")
+            if args.telemetry:
+                print(f"telemetry: {args.telemetry} "
+                      f"(python -m apex_tpu.prof.timeline to analyze)")
+            if wd is not None:
+                print(f"health: {wd.format_line()}")
 
 
 def _train(args):

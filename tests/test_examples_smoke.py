@@ -89,6 +89,9 @@ def test_imagenet_example_telemetry_stream(monkeypatch, tmp_path, capsys):
     m = re.search(r"loader: stall ([\d.]+)%", out)
     assert m, f"no loader line in:\n{out[-2000:]}"
     assert "telemetry:" in out
+    # ISSUE 6: the watchdog is on by default under --telemetry and a
+    # healthy smoke run prints the ok health line at exit
+    assert "health: ok (0 alerts)" in out
 
     from apex_tpu.prof import timeline
     events = timeline.load_events(tel)

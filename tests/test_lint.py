@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J009 a known-bad snippet
+1. fixture self-tests — for every rule J001-J010 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -633,6 +633,78 @@ def test_j009_needs_a_jitted_call_between_clocks():
         return state, dt
     """
     assert _codes(src, "examples/demo.py") == []
+
+
+# -- J010: cost harvesting inside step loops ----------------------------------
+
+def test_j010_flags_cost_analysis_in_loop():
+    """The ISSUE-6 fixture: harvesting XLA costs per loop iteration
+    re-traces (and recompiles) every call — harvest once before."""
+    bad = """
+    import jax
+
+    step = jax.jit(lambda s, b: s + b)
+
+    def sweep(batches):
+        for b in batches:
+            cost = step.lower(0.0, b).compile().cost_analysis()
+            use(cost)
+    """
+    # .lower / .compile / .cost_analysis all sit on the same chain;
+    # codes dedup to one J010 (jax.jit itself is hoisted, so no J004)
+    assert _codes(bad) == ["J010"]
+
+
+def test_j010_flags_lower_of_jitted_name_in_loop():
+    bad = """
+    import jax
+
+    step = jax.jit(lambda s, b: s + b)
+
+    def probe(batches):
+        for b in batches:
+            hlo = step.lower(0.0, b)
+    """
+    assert _codes(bad) == ["J010"]
+
+
+def test_j010_waiver_with_reason_passes():
+    waived = """
+    import jax
+
+    step = jax.jit(lambda s, b: s + b)
+
+    def sweep(shapes):
+        for b in shapes:
+            # jaxlint: disable=J010 -- fixture: deliberate per-shape harvest
+            cost = step.lower(0.0, b).compile().cost_analysis()
+    """
+    assert _codes(waived) == []
+
+
+def test_j010_harvest_before_loop_passes():
+    ok = """
+    import jax
+
+    def sweep(fn, b, batches):
+        cost = jax.jit(fn).lower(b).compile().cost_analysis()
+        for bb in batches:
+            use(cost, bb)
+    """
+    assert _codes(ok) == []
+
+
+def test_j010_string_lower_and_re_compile_pass():
+    """`.lower()` on a string and `re.compile` are not jitted
+    computations — the receiver must be demonstrably jitted."""
+    ok = """
+    import re
+
+    def scan(names):
+        for n in names:
+            m = re.compile("x").match(n.lower())
+    """
+    assert _codes(ok) == []
 
 
 # -- J000: waiver hygiene -----------------------------------------------------

@@ -464,7 +464,8 @@ def test_timeline_collective_totals():
     assert a["collectives"]["per_step_bytes"] == 1000
     assert a["collectives"]["total_gb"] == round(1000 * 6 / 1e9, 4)
     assert a["retraces"] == {"compiles": 2, "respecializations": 0,
-                             "retraces": 0, "by_signature": []}
+                             "retraces": 0, "by_signature": [],
+                             "compile_s": 0.0}
     # TWO identical reduces per step (e.g. twin G/D trees), two compiles
     # -> four events divide to multiplicity 2, not 1
     a2 = timeline.analyze(base + [dict(coll, t=t)

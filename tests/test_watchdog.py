@@ -1,0 +1,305 @@
+"""Run-health watchdog (ISSUE 6): every rule fires on a synthetic
+stream, debounce bounds the alert rate, and the disabled path stays a
+bitwise no-op.
+
+The watchdog folds events ON the recorder thread — these tests drive it
+both synthetically (events injected straight through ``Recorder.event``,
+so each rule's trigger shape is pinned exactly) and through a real
+:class:`~apex_tpu.runtime.StepPipeline` loop (instrumentation-wiring
+proof + the bitwise-identity acceptance pin).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import runtime, telemetry, training
+from apex_tpu.prof import assert_trace_count
+from apex_tpu.telemetry import watchdog as wdog
+from apex_tpu.training import make_train_step
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.set_recorder(None)
+    yield
+    telemetry.set_recorder(None)
+
+
+def _recorder(tmp_path, **kw):
+    rec = telemetry.Recorder(str(tmp_path / "run.jsonl"))
+    wd = wdog.attach(rec, **kw)
+    return rec, wd
+
+
+def _alerts(rec):
+    rec.close()
+    with open(rec.path) as f:
+        return [e for e in (json.loads(line) for line in f
+                            if line.strip())
+                if e["kind"] == "alert"]
+
+
+# -- individual rules ---------------------------------------------------------
+
+def test_nonfinite_rule_fires_with_global_step(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("metrics", step=8, n_valid=4,
+              loss=[1.0, 2.0, float("nan"), 1.0])
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["nonfinite"]
+    assert alerts[0]["step"] == 10                 # 8 + offset 2
+    assert alerts[0]["severity"] == "critical"
+
+
+def test_nonfinite_inf_counts_too(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("metrics", step=0, n_valid=1, loss=[float("inf")])
+    assert [a["rule"] for a in _alerts(rec)] == ["nonfinite"]
+
+
+def test_scale_collapse_on_consecutive_skips(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    for s in range(10, 14):                       # 4 consecutive skips
+        rec.event("scale", event="skip", step=s, scale=4096.0)
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["scale_collapse"]
+    assert alerts[0]["step"] == 13
+    assert "consecutive" in alerts[0]["message"]
+
+
+def test_scale_collapse_isolated_skips_are_benign(tmp_path):
+    """Dynamic scaling EXPECTS occasional skips: non-consecutive ones
+    (and growth in between) must not alert."""
+    rec, wd = _recorder(tmp_path)
+    for s in (10, 40, 80):
+        rec.event("scale", event="skip", step=s, scale=4096.0)
+        rec.event("scale", event="grow", step=s + 16, scale=8192.0)
+    assert _alerts(rec) == []
+    assert wd.health()["ok"]
+
+
+def test_scale_collapse_on_floor(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("scale", event="skip", step=5, scale=1.0)
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["scale_collapse"]
+    assert "floor" in alerts[0]["message"]
+
+
+def test_loader_stall_from_final_snapshot(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("loader", phase="exhausted",
+              stats={"loader_stall_pct": 45.0})
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["loader_stall"]
+    assert alerts[0]["value"] == 45.0
+
+
+def test_loader_stall_rolling_window_synthetic():
+    """A rolling window of loader_wait events exceeding the stall
+    threshold alerts DURING the run (before any final snapshot);
+    timestamps are synthetic so the fraction is deterministic."""
+    rule = wdog._LoaderStall(stall_pct=30.0, window=8)
+    hit = None
+    for i in range(9):
+        hit = rule.observe({"kind": "loader_wait", "t": i * 0.1,
+                            "dur": 0.06}) or hit
+    assert hit is not None and hit["value"] > 30.0
+    # healthy loader: 1 ms waits over the same wall never alerts
+    rule2 = wdog._LoaderStall(stall_pct=30.0, window=8)
+    for i in range(20):
+        assert rule2.observe({"kind": "loader_wait", "t": i * 0.1,
+                              "dur": 0.001}) is None
+
+
+def test_loader_stall_no_false_positive_after_window_fills():
+    """Review regression pin: after the measurement window fills, the
+    wait sum and the wall anchor reset TOGETHER — a healthy loader
+    (1 ms waits every 100 ms, true stall 1%) must never alert, no
+    matter how many windows elapse."""
+    rule = wdog._LoaderStall(stall_pct=30.0, window=8)
+    for i in range(100):
+        assert rule.observe({"kind": "loader_wait", "t": i * 0.1,
+                             "dur": 0.001}) is None
+    # and a genuinely stalling stretch STILL alerts after clean windows
+    hit = None
+    for i in range(100, 109):
+        hit = rule.observe({"kind": "loader_wait", "t": i * 0.1,
+                            "dur": 0.06}) or hit
+    assert hit is not None and hit["value"] > 30.0
+
+
+def test_step_time_anomaly_vs_rolling_baseline(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    for i in range(12):
+        rec.event("window", step=i, k=1, n_valid=1, dur=0.01, gap=0.0,
+                  program="hot")
+    rec.event("window", step=12, k=1, n_valid=1, dur=0.2, gap=0.0,
+              program="hot")
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["step_time"]
+    assert alerts[0]["step"] == 12
+    assert "x the rolling median" in alerts[0]["message"]
+
+
+def test_step_time_waits_for_baseline(tmp_path):
+    """Compile-sized windows BEFORE the baseline fills (min_samples)
+    must not alert — warmup is not an anomaly."""
+    rec, wd = _recorder(tmp_path)
+    rec.event("window", step=0, k=1, n_valid=1, dur=3.0, gap=0.0,
+              program="hot")                       # the compile call
+    for i in range(1, 6):
+        rec.event("window", step=i, k=1, n_valid=1, dur=0.01, gap=0.0,
+                  program="hot")
+    assert _alerts(rec) == []
+
+
+def test_retrace_storm_counts_only_true_retraces(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    # first compiles and benign re-specializations never count
+    rec.event("retrace", program="hot", step=0, n_traces=1, first=True,
+              new_sig=True, sig="a")
+    rec.event("retrace", program="hot", step=1, n_traces=2, first=False,
+              new_sig=False, sig="a")
+    for i in range(3):                             # the storm
+        rec.event("retrace", program="hot", step=10 + i,
+                  n_traces=3 + i, first=False, new_sig=True,
+                  sig=f"s{i}")
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["retrace_storm"]
+    assert alerts[0]["value"] == 3
+
+
+# -- debounce -----------------------------------------------------------------
+
+def test_debounce_bounds_alert_rate(tmp_path):
+    """A wedged run triggering every window gets ONE alert per rule per
+    debounce window, not one per event."""
+    rec, wd = _recorder(tmp_path, debounce_steps=64)
+    for step in range(0, 200, 4):
+        rec.event("metrics", step=step, n_valid=4,
+                  loss=[float("nan")] * 4)
+    alerts = _alerts(rec)
+    # steps 0..196: debounce at 64 -> alerts near steps 0/64/128/192
+    assert 3 <= len(alerts) <= 4
+    steps = [a["step"] for a in alerts]
+    assert all(b - a >= 64 for a, b in zip(steps, steps[1:]))
+
+
+def test_debounce_is_per_rule(tmp_path):
+    """One rule firing must not suppress a DIFFERENT rule."""
+    rec, wd = _recorder(tmp_path, debounce_steps=1000)
+    rec.event("metrics", step=0, n_valid=1, loss=[float("nan")])
+    rec.event("scale", event="skip", step=1, scale=1.0)
+    assert sorted(a["rule"] for a in _alerts(rec)) \
+        == ["nonfinite", "scale_collapse"]
+
+
+# -- stream + summary integration ---------------------------------------------
+
+def test_alerts_land_in_stream_summary_and_analyzer(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("metrics", step=3, n_valid=1, loss=[float("nan")])
+    rec.close()
+    with open(rec.path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    kinds = [e["kind"] for e in events]
+    assert "alert" in kinds
+    summary = events[-1]
+    assert summary["kind"] == "summary"
+    assert summary["health"]["ok"] is False
+    assert summary["health"]["by_rule"] == {"nonfinite": 1}
+    assert summary["events"]["alert"] == 1
+    from apex_tpu.prof import timeline
+    a = timeline.analyze(events)
+    assert a["alerts"] == {"total": 1, "by_rule": {"nonfinite": 1},
+                           "steps": [3]}
+    assert "watchdog alert" in timeline.format_report(a)
+
+
+def test_health_line_formats(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    assert wd.format_line() == "ok (0 alerts)"
+    rec.event("metrics", step=0, n_valid=1, loss=[float("nan")])
+    line = wd.format_line()
+    assert line.startswith("CRITICAL") and "nonfinite x1" in line
+    rec.close()
+
+
+def test_telemetry_start_watchdog_kwarg(tmp_path):
+    rec = telemetry.start(str(tmp_path / "r.jsonl"), watchdog=True,
+                          example="t")
+    assert isinstance(rec.watchdog, telemetry.Watchdog)
+    rec.close()
+    rec2 = telemetry.start(str(tmp_path / "r2.jsonl"), example="t")
+    assert rec2.watchdog is None
+    rec2.close()
+
+
+# -- through the real pipeline ------------------------------------------------
+
+def _loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+def _batches(n, bad_step=None):
+    rng = np.random.RandomState(0)
+    out = [(rng.randn(8, 4).astype(np.float32),
+            rng.randn(8, 2).astype(np.float32)) for _ in range(n)]
+    if bad_step is not None:
+        x, y = out[bad_step]
+        out[bad_step] = (x, np.full_like(y, np.inf))
+    return out
+
+
+def _run_pipeline(batches, rec=None):
+    init_fn, step_fn = make_train_step(
+        _loss_fn, training.sgd(lr=0.1), opt_level="O2",
+        loss_scale="dynamic")
+    pipe = runtime.StepPipeline(step_fn, k=4, telemetry=rec)
+    state = init_fn({"w": jnp.ones((4, 2), jnp.float32)})
+    with assert_trace_count(pipe.loop, 1):
+        state, reader = pipe.run(
+            state, runtime.window_batches(iter(batches), 4),
+            on_metrics=lambda wm: wm.fetch())
+    return state
+
+
+def test_disabled_path_bitwise_identical_with_watchdog(tmp_path):
+    """The acceptance pin: a telemetry+watchdog-enabled run produces
+    BITWISE-identical parameters to the disabled run, with the hot
+    program compiled exactly once (asserted inside _run_pipeline)."""
+    batches = _batches(12, bad_step=5)
+    off = _run_pipeline(batches, rec=None)
+    rec = telemetry.Recorder(str(tmp_path / "run.jsonl"))
+    wdog.attach(rec)
+    on = _run_pipeline(batches, rec=rec)
+    rec.close()
+    for a, b in zip(jax.tree_util.tree_leaves(off.params),
+                    jax.tree_util.tree_leaves(on.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_clean_pipeline_run_raises_no_alerts(tmp_path):
+    rec = telemetry.Recorder(str(tmp_path / "run.jsonl"))
+    wd = wdog.attach(rec)
+    _run_pipeline(_batches(8), rec=rec)
+    rec.close()
+    assert wd.health()["ok"], wd.alerts
+
+
+def test_nan_loss_through_pipeline_alerts(tmp_path):
+    """End to end: a poisoned batch -> deferred fetch -> metrics event
+    -> nonfinite alert in the stream, at the right global step."""
+    rec = telemetry.Recorder(str(tmp_path / "run.jsonl"))
+    wd = wdog.attach(rec)
+    _run_pipeline(_batches(12, bad_step=6), rec=rec)
+    rec.close()
+    nonfin = [a for a in wd.alerts if a["rule"] == "nonfinite"]
+    assert nonfin and nonfin[0]["step"] == 6

@@ -1,6 +1,6 @@
 """jaxlint core — AST rules, waiver handling, and the lint engine.
 
-Nine rules tuned to this codebase's failure modes (the ones that are
+Ten rules tuned to this codebase's failure modes (the ones that are
 invisible to pytest and surface as 10x dispatch-floor regressions in
 ``bench.py``):
 
@@ -57,6 +57,15 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   second clock; calls to local helpers that sync internally count
   (ISSUE 5: the static twin of the telemetry stream's measured-window
   contract).
+* **J010** cost harvesting inside step loops: ``.cost_analysis()`` /
+  ``.memory_analysis()``, or ``.lower()``/``.compile()`` of a jitted
+  computation, called inside a loop body.  Each ``lower`` re-traces and
+  each ``compile`` re-runs the backend — seconds per call on a real
+  chip, and none of it is cached across loop iterations.  Costs are
+  static per (shapes, dtypes): harvest ONCE before the loop
+  (``apex_tpu.prof.roofline.harvest_costs``) and reuse the result
+  (ISSUE 6: the static twin of the roofline engine's harvest-at-trace-
+  time contract).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -95,6 +104,9 @@ RULES: Dict[str, str] = {
     "J009": "wall-clock timing around a jitted call with no sync in the "
             "timed span (async dispatch: the clock measures enqueue, not "
             "compute)",
+    "J010": "cost_analysis()/lower()/compile() of a jitted computation "
+            "inside a loop (re-traces and recompiles per call; harvest "
+            "once before the loop)",
 }
 
 # Functions whose *contract* is the host boundary: serialization must
@@ -885,6 +897,7 @@ class _ScopeWalker:
                         self._check_j001_call(sub, loop_depth, leaf_loop)
                         self._check_j004_call(sub, loop_depth, loop_vars)
                         self._check_j007_call(sub, loop_depth)
+                        self._check_j010_call(sub, loop_depth)
                         self._collect_j009(sub)
         # While tests live on the stmt itself
         if isinstance(stmt, ast.While):
@@ -969,6 +982,46 @@ class _ScopeWalker:
             f"host->device staging belongs in the input engine "
             f"(PrefetchLoader / stage_windows device=...), where it "
             f"overlaps compute instead of serializing with each step"))
+
+    # .. J010 .................................................................
+
+    # Compile-triggering analysis entry points.  The bare attr names fire
+    # anywhere in a loop; ``lower``/``compile`` only when the receiver is
+    # demonstrably a jitted computation (``jax.jit(f).lower(...)``, a
+    # known-jitted name, or a ``.lower(...)`` chain) — ``s.lower()`` on a
+    # string and ``re.compile`` must not flag.
+    _J010_HARVEST_ATTRS = ("cost_analysis", "memory_analysis")
+
+    def _check_j010_call(self, call: ast.Call, loop_depth: int) -> None:
+        if loop_depth == 0:
+            return
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return
+        if f.attr in self._J010_HARVEST_ATTRS:
+            what = f".{f.attr}()"
+        elif f.attr in ("lower", "compile"):
+            recv = f.value
+            jitted_recv = (
+                (isinstance(recv, ast.Call)
+                 and (_is_jax_jit(recv.func)
+                      or (isinstance(recv.func, ast.Attribute)
+                          and recv.func.attr == "lower")))
+                or (isinstance(recv, ast.Name)
+                    and self.idx.jitted_name(self.fn, recv.id)))
+            if not jitted_recv:
+                return
+            what = f".{f.attr}()"
+        else:
+            return
+        self.findings.append(Finding(
+            self.path, call.lineno, call.col_offset, "J010",
+            f"{what} inside a loop — every call re-traces (and "
+            f"`.compile()` re-runs the backend, seconds per call on a "
+            f"real chip); costs are static per (shapes, dtypes), so "
+            f"harvest ONCE before the loop "
+            f"(apex_tpu.prof.roofline.harvest_costs) and reuse the "
+            f"result"))
 
     # .. J009 .................................................................
 
