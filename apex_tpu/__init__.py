@@ -17,6 +17,9 @@ snapshot surveyed in SURVEY.md), designed TPU-first:
 * run telemetry (``apex_tpu.telemetry``) — structured JSONL event stream
   + metrics registry for live runs; offline analysis via
   ``python -m apex_tpu.prof.timeline``.
+* warm start (``apex_tpu.cache``) — persistent XLA compilation cache +
+  AOT warmup of the step-pipeline device loop (zero compiles after
+  step 0).
 * legacy surfaces: ``bf16_utils`` (= reference fp16_utils), ``RNN``,
   ``reparameterization``, ``contrib``.
 """
@@ -32,7 +35,7 @@ import importlib as _importlib
 
 _LAZY = ("optimizers", "normalization", "parallel", "bf16_utils", "fp16_utils",
          "RNN", "reparameterization", "contrib", "prof", "training", "models",
-         "runtime", "data", "telemetry")
+         "runtime", "data", "telemetry", "cache")
 
 
 def __getattr__(name):

@@ -1,0 +1,158 @@
+"""Warm-start engine: persistent compilation cache + AOT warmup (ISSUE 7).
+
+Two cold-start taxes keep the examples' steady state from beginning at
+step 1 (r05: imagenet 1530 img/s steady vs 2492 best-window, and the
+``--prof`` best-window probes each pay fresh compiles):
+
+* the **first-run compile** — tens of seconds of XLA backend work that
+  re-runs on every process start even though nothing changed;
+* the **step-0 trace+compile inside the timed loop** — the
+  :class:`~apex_tpu.runtime.StepPipeline` device loop compiles on its
+  first dispatch (and re-specializes on call 1 when the donated state
+  returns with the mesh sharding), so the steady clock must exclude the
+  first two calls.
+
+This module removes both:
+
+* :func:`enable` turns on jax's **persistent compilation cache** (an
+  on-disk executable store keyed by HLO fingerprint): the second process
+  start deserializes instead of recompiling — cold compiles are paid
+  once per (program, jaxlib), not once per run.
+* :func:`warmup` **AOT-compiles** a pipeline's device loop for the
+  declared ``(K, shape)`` signatures BEFORE step 0 —
+  ``jit(...).lower(shapes).compile()`` on abstract
+  ``ShapeDtypeStruct``s, so no real data, no real step, no state
+  mutation.  :meth:`StepPipeline.warmup
+  <apex_tpu.runtime.StepPipeline.warmup>` stores the compiled
+  executable and dispatches straight to it, bypassing the jit tracing
+  machinery entirely: with a warm cache there are ZERO compiles (and
+  zero traces) after step 0, which
+  :func:`apex_tpu.prof.assert_trace_count` can pin.
+
+Usage::
+
+    import apex_tpu.cache
+    apex_tpu.cache.enable("~/.cache/apex_tpu_xla")   # once, at startup
+
+    pipe = runtime.StepPipeline(step_fn, k, ...)
+    pipe.warmup(state, window)          # AOT: compile before step 0
+    for window, n in windows:
+        state, metrics = pipe.step_window(state, window, n)   # no compiles
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+__all__ = ["enable", "is_enabled", "cache_dir", "abstractify",
+           "signature", "warmup"]
+
+_STATE = {"dir": None}
+
+
+def enable(path: str, *,
+           min_entry_size_bytes: int = -1,
+           min_compile_time_secs: float = 0.0) -> str:
+    """Enable jax's persistent compilation cache at ``path``.
+
+    Creates the directory, points ``jax_compilation_cache_dir`` at it
+    and drops the size/compile-time floors (both default to "cache
+    everything": a train-step executable is always worth keeping; the
+    defaults exist to keep tiny one-off programs out of shared caches).
+    Falls back to the legacy ``initialize_cache`` API on old jax.
+    Idempotent; returns the resolved directory.
+    """
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        if _STATE["dir"] not in (None, path):
+            # The backend binds its store on first use; re-pointing the
+            # config alone would silently keep writing to the old dir.
+            try:
+                from jax._src import compilation_cache as _cci
+                _cci.reset_cache()
+            except Exception:                    # pragma: no cover
+                pass
+    except AttributeError:                       # pragma: no cover
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.initialize_cache(path)
+    # Cache-everything floors; individually best-effort (older jaxlibs
+    # lack one or both knobs, and the defaults there already cache
+    # training-sized programs).
+    for name, val in (
+            ("jax_persistent_cache_min_entry_size_bytes",
+             min_entry_size_bytes),
+            ("jax_persistent_cache_min_compile_time_secs",
+             min_compile_time_secs)):
+        try:
+            jax.config.update(name, val)
+        except (AttributeError, ValueError):     # pragma: no cover
+            pass
+    _STATE["dir"] = path
+    return path
+
+
+def is_enabled() -> bool:
+    return _STATE["dir"] is not None
+
+
+def cache_dir() -> Optional[str]:
+    """The directory :func:`enable` installed (None when disabled)."""
+    return _STATE["dir"]
+
+
+def abstractify(tree):
+    """Pytree of ``ShapeDtypeStruct``s mirroring ``tree``'s arrays —
+    shape, dtype AND sharding (jit specializes on all three; dropping
+    the sharding would AOT-compile a program the real dispatch then
+    can't use).  Non-array leaves (plain ints/bools) pass through and
+    specialize the compile exactly like a real call."""
+    def one(leaf):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return leaf        # caller-declared template (sharding kept)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            # Pin only COMMITTED placements (device_put with an explicit
+            # sharding — e.g. a mesh-staged batch window).  Uncommitted
+            # arrays (fresh init output on the default device) must stay
+            # unconstrained: pinning their incidental single-device
+            # sharding next to a mesh-sharded window is a device-set
+            # conflict at lower(), and the partitioner's free choice is
+            # exactly what the real call gets.
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and getattr(leaf, "committed", False):
+                try:
+                    return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                                sharding=sharding)
+                except TypeError:                # pragma: no cover
+                    pass                         # old jax: no kwarg
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        return leaf
+    return jax.tree_util.tree_map(one, tree)
+
+
+def signature(tree, limit: int = 16) -> Tuple[str, ...]:
+    """Shape/dtype signature of a pytree's leading leaves — the AOT
+    executable lookup key (matches the retrace-event signature the
+    runtime emits, so telemetry and warmup agree on what "same window"
+    means)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return tuple(f"{getattr(l, 'dtype', type(l).__name__)}"
+                 f"{list(getattr(l, 'shape', ()))}"
+                 for l in leaves[:limit])
+
+
+def warmup(jitted, *args) -> Any:
+    """AOT-compile ``jitted`` (a ``jax.jit`` callable) for ``args``'
+    signature: ``lower().compile()`` over :func:`abstractify`-ed
+    arguments.  Nothing executes and nothing is donated — ``args`` may
+    be live training state.  Returns the compiled executable; call it
+    with concrete arrays of the same signature to bypass tracing
+    entirely.  With the persistent cache :func:`enable`-d, the backend
+    compile inside is itself a disk hit on the second process start.
+    """
+    return jitted.lower(*abstractify(args)).compile()

@@ -32,8 +32,11 @@ class BatchNorm2d_NHWC(nn.Module):
     bn_group=1)`` (``contrib/groupbn/batch_norm.py:101+``).  ``bn_group``
     is the number of replicas that share statistics; groups are contiguous
     rank blocks like ``create_syncbn_process_group``
-    (``apex/parallel/__init__.py:55-96``)."""
-    num_features: int
+    (``apex/parallel/__init__.py:55-96``).  ``num_features`` may be left
+    None to infer from the input's channel dim — the norm-factory
+    contract :class:`apex_tpu.models.resnet.ResNet` calls with
+    (``norm_cls=``)."""
+    num_features: Optional[int] = None
     fuse_relu: bool = False
     bn_group: int = 1
     eps: float = 1e-5
@@ -41,6 +44,8 @@ class BatchNorm2d_NHWC(nn.Module):
     axis_name: Optional[str] = None
     world_size: Optional[int] = None
     use_running_average: Optional[bool] = None
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
 
     @nn.compact
     def __call__(self, x, z=None, use_running_average=None):
@@ -69,5 +74,6 @@ class BatchNorm2d_NHWC(nn.Module):
             process_group=process_group, channel_last=True,
             fuse_relu=self.fuse_relu,
             use_running_average=self.use_running_average,
+            scale_init=self.scale_init, bias_init=self.bias_init,
             name="bn")
         return bn(x, z=z, use_running_average=use_running_average)

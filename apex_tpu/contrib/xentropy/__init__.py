@@ -112,7 +112,7 @@ def _bwd_kernel(g_ref, x_ref, mlse_ref, lab_ref, dx_ref, *, smoothing):
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
-def _fwd_pallas(logits, labels, smoothing):
+def _fwd_pallas(logits, labels, smoothing, interpret=False):
     n, h = logits.shape
     blk = _row_block(n, h)
     grid = (n + blk - 1) // blk
@@ -125,11 +125,12 @@ def _fwd_pallas(logits, labels, smoothing):
                    pl.BlockSpec((blk, 1), lambda i: (i, 0))],
         out_shape=[_sds((n, 1), jnp.float32, logits),
                    _sds((n, 1), jnp.float32, logits)],
+        interpret=interpret,   # CPU tier-parity tests run the REAL kernel
     )(logits, labels[:, None])
     return loss[:, 0], mlse[:, 0]
 
 
-def _bwd_pallas(g, logits, mlse, labels, smoothing):
+def _bwd_pallas(g, logits, mlse, labels, smoothing, interpret=False):
     n, h = logits.shape
     blk = _row_block(n, h)
     grid = (n + blk - 1) // blk
@@ -142,6 +143,7 @@ def _bwd_pallas(g, logits, mlse, labels, smoothing):
                   pl.BlockSpec((blk, 1), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((blk, h), lambda i: (i, 0)),
         out_shape=_sds((n, h), logits.dtype, logits, g),
+        interpret=interpret,
     )(g[:, None], logits, mlse[:, None], labels[:, None])
 
 

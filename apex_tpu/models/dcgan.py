@@ -24,13 +24,13 @@ class Generator(nn.Module):
         x = nn.Dense(4 * 4 * self.ngf * 8, dtype=self.dtype,
                      param_dtype=jnp.float32, name="project")(z)
         x = x.reshape(z.shape[0], 4, 4, self.ngf * 8)
-        x = nn.relu(norm("bn0")(x))
+        x = nn.relu(norm("bn0")(x))  # jaxlint: disable=J011 -- generator activations are 4x4..32x32 (far below the epilogue's dispatch crossover); the fused-epilogue rewire is the imagenet path's, tracked for dcgan in ROADMAP
         for i, mult in enumerate((4, 2, 1)):
             x = nn.ConvTranspose(self.ngf * mult, (4, 4), (2, 2),
                                  padding="SAME", dtype=self.dtype,
                                  param_dtype=jnp.float32,
                                  name=f"deconv{i + 1}")(x)
-            x = nn.relu(norm(f"bn{i + 1}")(x))
+            x = nn.relu(norm(f"bn{i + 1}")(x))  # jaxlint: disable=J011 -- same: tiny generator maps sit below the fused epilogue's crossover
         x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding="SAME",
                              dtype=self.dtype, param_dtype=jnp.float32,
                              name="deconv_out")(x)

@@ -7,6 +7,21 @@ created fp32 via the keep-bn-fp32 path convention — parameters live under
 ``bn``-prefixed names so ``amp.convert_params`` keeps them fp32), and
 SyncBatchNorm-pluggable for the ``--sync_bn`` flow
 (``main_amp.py:141-146``).
+
+**Fused conv epilogues (ISSUE 7).**  Every residual block is a chain of
+``conv -> bn -> relu`` with a trailing ``bn -> (+residual) -> relu``; on
+the memory-bound amp-O2 step those elementwise tails are where the HBM
+bytes go (r05 ledger: ~93% of HBM peak, MXU 25% busy).  The blocks
+therefore route each chain through a *norm-factory hook*: when the norm
+module supports the apex ``bn_relu``/``bn_add_relu`` contract
+(``fuse_relu=`` ctor flag + ``z=`` residual call arg — SyncBatchNorm and
+``contrib.groupbn.BatchNorm2d_NHWC`` both do, backed by the Pallas
+:func:`apex_tpu.normalization.bn_relu_residual` epilogue), the whole
+chain becomes ONE fused epilogue; plain ``nn.BatchNorm`` keeps the
+explicit ``relu(bn(y) + residual)`` statements.  ``norm_cls`` injects an
+external factory (e.g. ``functools.partial(BatchNorm2d_NHWC,
+bn_group=...)``); ``fused_epilogue`` forces the routing on (error if
+unsupported) or off.
 """
 
 from __future__ import annotations
@@ -23,11 +38,40 @@ from ..parallel import SyncBatchNorm
 ModuleDef = Any
 
 
+def _norm_factory_cls(norm) -> Any:
+    """The module class under a (possibly nested) functools.partial."""
+    while isinstance(norm, functools.partial):
+        norm = norm.func
+    return norm
+
+
+def norm_supports_epilogue(norm) -> bool:
+    """True when ``norm`` builds modules with the fused-epilogue contract
+    (``fuse_relu`` ctor flag, ``z=`` residual call arg) — the hook the
+    blocks key their ``bn -> relu -> (+residual)`` routing on."""
+    return hasattr(_norm_factory_cls(norm), "fuse_relu")
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
     conv: ModuleDef
     norm: ModuleDef
+    #: fused bn(+z)+relu factory (``fuse_relu=True`` pre-bound), or None
+    #: for the explicit relu/add statements (plain ``nn.BatchNorm``).
+    norm_act: Optional[ModuleDef] = None
+
+    def _bn_relu(self, y, name):
+        if self.norm_act is not None:
+            return self.norm_act(name=name)(y)
+        return nn.relu(self.norm(name=name)(y))
+
+    def _bn_add_relu(self, y, residual, name, **kw):
+        """The trailing ``bn -> (+residual) -> relu`` chain — the apex
+        ``bn_add_relu`` epilogue when the norm supports it."""
+        if self.norm_act is not None:
+            return self.norm_act(name=name, **kw)(y, residual)
+        return nn.relu(residual + self.norm(name=name, **kw)(y))
 
     @nn.compact
     def __call__(self, x):
@@ -38,21 +82,19 @@ class BottleneckBlock(nn.Module):
         residual = x
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
         y = checkpoint_name(y, "conv_out")
-        y = self.norm(name="bn1")(y)
-        y = nn.relu(y)
+        y = self._bn_relu(y, "bn1")
         y = self.conv(self.filters, (3, 3), self.strides, name="conv2")(y)
         y = checkpoint_name(y, "conv_out")
-        y = self.norm(name="bn2")(y)
-        y = nn.relu(y)
+        y = self._bn_relu(y, "bn2")
         y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
         y = checkpoint_name(y, "conv_out")
-        y = self.norm(name="bn3", scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters * 4, (1, 1), self.strides,
                                  name="downsample_conv")(residual)
             residual = checkpoint_name(residual, "conv_out")
             residual = self.norm(name="downsample_bn")(residual)
-        return nn.relu(residual + y)
+        return self._bn_add_relu(y, residual, "bn3",
+                                 scale_init=nn.initializers.zeros)
 
 
 class BasicBlock(nn.Module):
@@ -60,20 +102,23 @@ class BasicBlock(nn.Module):
     strides: Tuple[int, int]
     conv: ModuleDef
     norm: ModuleDef
+    norm_act: Optional[ModuleDef] = None
+
+    _bn_relu = BottleneckBlock._bn_relu
+    _bn_add_relu = BottleneckBlock._bn_add_relu
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (3, 3), self.strides, name="conv1")(x)
-        y = self.norm(name="bn1")(y)
-        y = nn.relu(y)
+        y = self._bn_relu(y, "bn1")
         y = self.conv(self.filters, (3, 3), name="conv2")(y)
-        y = self.norm(name="bn2", scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1), self.strides,
                                  name="downsample_conv")(residual)
             residual = self.norm(name="downsample_bn")(residual)
-        return nn.relu(residual + y)
+        return self._bn_add_relu(y, residual, "bn2",
+                                 scale_init=nn.initializers.zeros)
 
 
 class ResNet(nn.Module):
@@ -86,6 +131,19 @@ class ResNet(nn.Module):
     axis_name: Optional[str] = None
     bn_process_group: Optional[Sequence[Sequence[int]]] = None
     bn_momentum: float = 0.1
+    #: external norm factory (a module class or functools.partial over
+    #: one), e.g. ``functools.partial(contrib.groupbn.BatchNorm2d_NHWC,
+    #: bn_group=2, axis_name="data", world_size=8)``.  The factory is
+    #: called per site as ``norm(name=..., [scale_init=...])`` and must
+    #: accept ``use_running_average``; when it carries the fused-epilogue
+    #: contract the blocks route their chains through it.  Overrides
+    #: ``sync_bn``.
+    norm_cls: Any = None
+    #: route ``bn -> relu -> (+residual)`` chains through the norm's
+    #: fused epilogue: None = auto (fuse when the norm supports it),
+    #: True = require it (ValueError if the norm can't), False = keep
+    #: the explicit relu/add statements.
+    fused_epilogue: Optional[bool] = None
     # Rematerialization per residual block (jax.checkpoint), an HBM-
     # traffic experiment knob for the bandwidth-bound O2 step (~93% of
     # HBM peak, MXU ~25% busy — r5 bytes ledger):
@@ -101,7 +159,10 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
                                  param_dtype=jnp.float32)
-        if self.sync_bn:
+        if self.norm_cls is not None:
+            norm = functools.partial(self.norm_cls,
+                                     use_running_average=not train)
+        elif self.sync_bn:
             norm = functools.partial(
                 SyncBatchNorm, momentum=self.bn_momentum,
                 axis_name=self.axis_name if train else None,
@@ -113,10 +174,24 @@ class ResNet(nn.Module):
                 momentum=1.0 - self.bn_momentum, epsilon=1e-5,
                 dtype=self.dtype, param_dtype=jnp.float32)
 
+        fused = self.fused_epilogue
+        if fused is None:
+            fused = norm_supports_epilogue(norm)
+        elif fused and not norm_supports_epilogue(norm):
+            raise ValueError(
+                f"fused_epilogue=True but norm factory "
+                f"{_norm_factory_cls(norm).__name__} has no fuse_relu/z "
+                f"contract — use SyncBatchNorm / contrib.groupbn."
+                f"BatchNorm2d_NHWC or pass fused_epilogue=False")
+        norm_act = functools.partial(norm, fuse_relu=True) if fused else None
+
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="conv_init")(x)
-        x = norm(name="bn_init")(x)
-        x = nn.relu(x)
+        if norm_act is not None:
+            x = norm_act(name="bn_init")(x)
+        else:
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)  # jaxlint: disable=J011 -- this IS the deliberate unfused fallback (fused_epilogue=False / plain nn.BatchNorm); the fused routing is the branch above
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         block_cls = self.block_cls
         if self.remat:
@@ -136,7 +211,7 @@ class ResNet(nn.Module):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = block_cls(self.num_filters * 2 ** i, strides,
-                              conv=conv, norm=norm,
+                              conv=conv, norm=norm, norm_act=norm_act,
                               name=f"stage{i + 1}_block{j + 1}")(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,

@@ -113,9 +113,21 @@ class BucketStore:
     Without a mask every bucket carries ``decay=True`` (decay applies
     wherever the optimizer's ``weight_decay`` says, matching the
     leafwise behavior).
+
+    ``max_bucket_elems`` (optional) caps each bucket's element count,
+    splitting a ``(dtype, decay)`` group into several buckets in leaf
+    order — the apex-DDP ``message_size`` analog.  One giant bucket is
+    a *barrier*: its collective cannot start until every grad in it is
+    final, i.e. until the whole backward is done.  Chunked buckets give
+    :func:`apex_tpu.parallel.reduce_gradients` per-chunk psums whose
+    data dependencies close as backward progresses, so XLA's
+    latency-hiding scheduler overlaps wire time with the remaining
+    backward compute (ISSUE 7).  A leaf larger than the cap gets its
+    own bucket (leaves are never split).
     """
 
-    def __init__(self, template, *, decay_mask=None):
+    def __init__(self, template, *, decay_mask=None,
+                 max_bucket_elems: Optional[int] = None):
         leaves, treedef = jax.tree_util.tree_flatten(template)
         self.treedef = treedef
         self.n_leaves = len(leaves)
@@ -129,10 +141,16 @@ class BucketStore:
                     f"{len(leaves)}")
             mask = [bool(m) for m in mask]
 
+        if max_bucket_elems is not None and max_bucket_elems < 1:
+            raise ValueError(
+                f"max_bucket_elems must be >= 1, got {max_bucket_elems}")
+        self.max_bucket_elems = max_bucket_elems
+
         # float_slot[i] = (bucket_id, segment index within bucket) for
         # flat leaf i; None marks a passthrough (non-float) leaf.
         self._slots: list = [None] * len(leaves)
         order: dict = {}                        # key -> bucket build dict
+        chunk_of: dict = {}                     # (dtype, decay) -> chunk idx
         self._rest_ids: list = []
         for i, leaf in enumerate(leaves):
             if not _is_float_leaf(leaf):
@@ -141,16 +159,27 @@ class BucketStore:
                 continue
             shape = tuple(int(s) for s in jnp.shape(leaf))
             size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            key = (jnp.dtype(leaf.dtype), mask[i])
-            b = order.setdefault(key, dict(leaf_ids=[], offsets=[],
-                                           sizes=[], shapes=[], total=0))
+            group = (jnp.dtype(leaf.dtype), mask[i])
+            key = (group, chunk_of.setdefault(group, 0))
+            b = order.get(key)
+            if (b is not None and max_bucket_elems is not None
+                    and b["total"] and b["total"] + size > max_bucket_elems):
+                # start a fresh chunk; an oversized single leaf still
+                # lands alone in its own bucket (never split).
+                chunk_of[group] += 1
+                key = (group, chunk_of[group])
+                b = None
+            if b is None:
+                b = order.setdefault(key, dict(leaf_ids=[], offsets=[],
+                                               sizes=[], shapes=[],
+                                               total=0))
             b["leaf_ids"].append(i)
             b["offsets"].append(b["total"])
             b["sizes"].append(size)
             b["shapes"].append(shape)
             b["total"] += size
         self.buckets: Tuple[_Bucket, ...] = tuple(
-            _Bucket(dtype=key[0], decay=key[1],
+            _Bucket(dtype=key[0][0], decay=key[0][1],
                     leaf_ids=tuple(b["leaf_ids"]),
                     offsets=tuple(b["offsets"]),
                     sizes=tuple(b["sizes"]),
@@ -316,6 +345,24 @@ class BucketStore:
         scalars (trust ratios, norm denominators) into elementwise
         multipliers in one gather."""
         return jnp.take(per_leaf_vals, self.segment_ids(bucket_index))
+
+    def reverse_topological_order(self) -> Tuple[int, ...]:
+        """Bucket indices in the order their gradients become *final*
+        during backward (ISSUE 7 collective/compute overlap).
+
+        Backward differentiates the forward in reverse: the grad of
+        flat leaf ``i`` is finalized roughly at backward time
+        ``n_leaves - i`` (flattened-tree order tracks forward use for
+        the standard top-down module layout).  A bucket is ready for
+        its psum once its *last*-finalizing grad — its minimum leaf id
+        — is done, so buckets are issued by DESCENDING min leaf id:
+        deepest-layer chunks first, each psum's data dependencies
+        closing while earlier layers are still differentiating.
+        :func:`apex_tpu.parallel.reduce_gradients` issues the
+        per-bucket collectives in this order."""
+        return tuple(sorted(
+            range(len(self.buckets)),
+            key=lambda bi: -min(self.buckets[bi].leaf_ids)))
 
     def leaf_order(self) -> Tuple[int, ...]:
         """Float-leaf indices in flattened-tree order — for reassembling

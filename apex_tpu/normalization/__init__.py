@@ -2,3 +2,5 @@
 
 from .fused_layer_norm import (FusedLayerNorm, fused_layer_norm,  # noqa: F401
                                fused_layer_norm_affine)
+from .fused_bn_act import (bn_relu_residual,  # noqa: F401
+                           bn_act_epilogue_ref)

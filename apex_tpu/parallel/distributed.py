@@ -311,7 +311,23 @@ def reduce_gradients(grads,
     if bucket_store is not None or isinstance(grads, Packed):
         packed = (grads if isinstance(grads, Packed)
                   else bucket_store.pack(grads))
-        out = jax.tree_util.tree_map(one, packed)   # one() per BUCKET
+        # Collective/compute overlap (ISSUE 7): issue the per-bucket
+        # psums in REVERSE-TOPOLOGICAL bucket order — each bucket's
+        # collective is emitted as soon as its grads are final (its
+        # pack depends only on its own leaves, so with a chunked store
+        # — BucketStore(max_bucket_elems=...) — the deepest layers'
+        # psum starts while earlier layers are still differentiating;
+        # XLA's latency-hiding scheduler turns the issue order + closed
+        # data deps into async start/done pairs riding the wire under
+        # the remaining backward).  One monolithic bucket degenerates
+        # to the old end-of-backward barrier.
+        order = (bucket_store.reverse_topological_order()
+                 if bucket_store is not None
+                 else tuple(range(len(packed.data))))
+        data = list(packed.data)
+        for bi in order:
+            data[bi] = one(data[bi])
+        out = Packed(data=tuple(data), rest=packed.rest)
         _note_collective("psum", axis_names, coll["bytes"], coll["n"],
                          dtype=_wire_dtype())
         if isinstance(grads, Packed):

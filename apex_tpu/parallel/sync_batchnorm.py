@@ -143,13 +143,26 @@ class SyncBatchNorm(nn.Module):
                                 + self.momentum * unbiased)
 
         invstd = lax.rsqrt(var + self.eps)
-        out = (x.astype(jnp.float32)
-               - mean.reshape(stat_shape)) * invstd.reshape(stat_shape)
+        weight = bias = None
         if self.affine:
             weight = self.param("scale", self.scale_init,
                                 (num_features,), jnp.float32)
             bias = self.param("bias", self.bias_init,
                               (num_features,), jnp.float32)
+        if self.channel_last:
+            # The whole elementwise tail — normalize, affine, the
+            # optional ``z`` residual add (reference batch_norm_add_relu)
+            # and the fused ReLU — is ONE conv-side epilogue: a Pallas
+            # pass on TPU, the op-identical jnp reference elsewhere
+            # (ISSUE 7).  Statistics (the psum above, running stats)
+            # stay in XLA; the epilogue's custom VJP hands their
+            # cotangents back exactly.
+            from ..normalization.fused_bn_act import bn_relu_residual
+            return bn_relu_residual(x, mean, invstd, weight, bias, z=z,
+                                    relu=self.fuse_relu)
+        out = (x.astype(jnp.float32)
+               - mean.reshape(stat_shape)) * invstd.reshape(stat_shape)
+        if self.affine:
             out = out * weight.reshape(stat_shape) + bias.reshape(stat_shape)
         if z is not None:
             # BN-add(-relu) fusion input (reference batch_norm_add_relu).

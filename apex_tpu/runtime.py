@@ -78,6 +78,32 @@ def _select_tree(flag, new, old):
         lambda n, o: jnp.where(flag, n, o), new, old)
 
 
+class _AotLoop:
+    """One dispatch through a warmed AOT executable, with jit fallback.
+
+    The compiled executable rejects arguments whose sharding/layout
+    drifted from the warmed signature; on such a failure the stale
+    entry is dropped and the dispatch retries through the jit path
+    (which traces/compiles as usual), so a bad warmup can cost at most
+    one compile — never a crash.  Only argument-VALIDATION errors
+    (ValueError/TypeError, raised before donation takes effect, so the
+    fallback re-uses the same live buffers) are treated as drift;
+    genuine runtime failures (device OOM, deleted buffers) propagate —
+    silently re-running them through a fresh compile would mask the
+    error AND double the damage."""
+
+    def __init__(self, pipe, key, compiled, jit_loop):
+        self._pipe, self._key = pipe, key
+        self._compiled, self._jit = compiled, jit_loop
+
+    def __call__(self, state, window, valid):
+        try:
+            return self._compiled(state, window, valid)
+        except (ValueError, TypeError):
+            self._pipe._aot.pop(self._key, None)
+            return self._jit(state, window, valid)
+
+
 class StepPipeline:
     """K train steps per host dispatch, as one compiled device loop.
 
@@ -152,6 +178,34 @@ class StepPipeline:
         #: the ragged-tail jitted callable (compiled on first tail, ever).
         self.tail_loop = jax.jit(tail, donate_argnums=donate)
         self._full_valid = np.ones((self.k,), np.bool_)
+        # AOT-warmed executables (ISSUE 7): (program, window signature)
+        # -> compiled, installed by warmup(); step_window dispatches to
+        # them directly, bypassing jit tracing entirely.
+        self._aot: dict = {}
+
+    def warmup(self, state, window, *, tail: bool = False):
+        """AOT-compile the device loop for this ``(state, window)``
+        signature BEFORE step 0 (``apex_tpu.cache.warmup``:
+        ``lower().compile()`` over abstract shapes — nothing runs,
+        nothing is donated, ``state``/``window`` may be live arrays or
+        ``ShapeDtypeStruct`` templates).  Subsequent ``step_window``
+        calls with matching windows dispatch straight to the compiled
+        executable: zero traces and zero compiles after step 0 (pin
+        with ``prof.assert_trace_count(pipe.loop, 0)``), and the call-1
+        donated-sharding re-specialization never happens because the
+        jit cache is never consulted.  ``tail=True`` also pre-compiles
+        the masked ragged-tail program.  With
+        :func:`apex_tpu.cache.enable` the backend compiles are disk
+        hits on the second process start.  Returns ``self``.
+        """
+        from . import cache as _cache
+        sig = _cache.signature(window)
+        self._aot[("hot", sig)] = _cache.warmup(
+            self.loop, state, window, self._full_valid)
+        if tail:
+            self._aot[("tail", sig)] = _cache.warmup(
+                self.tail_loop, state, window, self._full_valid)
+        return self
 
     def step_window(self, state, window, n_valid: Optional[int] = None):
         """Dispatch one window: K steps, ONE program.
@@ -173,6 +227,17 @@ class StepPipeline:
             loop, valid, n, program = (self.tail_loop,
                                        np.arange(self.k) < n_valid,
                                        n_valid, "tail")
+        if self._aot:
+            # Warm-start fast path: a warmed (program, window-signature)
+            # dispatches to the AOT executable — no tracing machinery at
+            # all.  A mismatch (e.g. input sharding drift vs the warmed
+            # layout) drops the stale entry and falls back to the jit
+            # path, which handles anything.
+            from . import cache as _cache
+            key = (program, _cache.signature(window))
+            aot = self._aot.get(key)
+            if aot is not None:
+                loop = _AotLoop(self, key, aot, loop)
         step0 = self._steps_done
         self._steps_done += n
         rec = (self._telemetry if self._telemetry is not None

@@ -24,7 +24,13 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # for the attribution/health engines (ISSUE 6): cost harvesting is a
 # static jaxpr walk and the watchdog a pure host fold, so every tier
 # must produce identical ledgers/alerts.
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py -q"
+# test_contrib.py + test_fused_bn_act.py ride along for the conv-path
+# fusion engine (ISSUE 7): the tier-parity tests run the REAL pallas
+# kernels in interpret mode against the jnp references, so the
+# no-pallas tiers must stay numerically identical.  test_cache.py rides
+# for the warm-start engine (AOT warmup is pure host machinery — every
+# tier must keep zero-compile-after-step-0 and bitwise parity).
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native
@@ -40,6 +46,32 @@ APEX_TPU_DISABLE_PALLAS=1 $FAST
 
 echo "=== tier 4: bare (both fallbacks) ==="
 APEX_TPU_DISABLE_NATIVE=1 APEX_TPU_DISABLE_PALLAS=1 $FAST
+
+echo "=== cross-run regression gate (prof.regress, ISSUE 7) ==="
+# Diff the freshest bench headline against the checked-in r05 baseline:
+# throughput/MFU regressions FAIL the matrix here instead of hiding
+# inside BENCH_EXTRA.  bench.py writes BENCH_SUMMARY.json on every full
+# run; a box that never ran the bench (CPU-only CI) skips the gate
+# loudly.  --tol-default 25: the tunneled chip swings ~±18% pass to
+# pass even under min-of-reps — this gate exists for the 2x class, the
+# bench's own self-validation holds the tight floors.  vs_prev ratios
+# compare different round pairs and are excluded outright.
+if [ -f BENCH_SUMMARY.json ]; then
+  # Freshness: a summary older than any source file gates the WRONG
+  # commit — the silent-regression case this step exists to catch.
+  STALE=$( (find apex_tpu bench.py -name '*.py' -newer BENCH_SUMMARY.json
+            || true) | head -1)
+  if [ -n "$STALE" ]; then
+    echo "BENCH_SUMMARY.json predates source change ($STALE) -- stale;"
+    echo "re-run 'python bench.py' on the chip to refresh; skipping"
+  else
+    python -m apex_tpu.prof.regress BENCH_r05.json BENCH_SUMMARY.json \
+      --tol-default 25 --tol vs_prev=10000 --tol window_gap_pct=10000 \
+      --tol loader_stall_pct=10000
+  fi
+else
+  echo "no fresh BENCH_SUMMARY.json (bench has not run on this box) -- skipping"
+fi
 
 echo "=== import smoke from outside the tree ==="
 (cd /tmp && PYTHONPATH="$OLDPWD" python -c "

@@ -618,3 +618,83 @@ def test_loss_scaler_bucketed_unscale_matches_leafwise():
     bad = dict(grads, p0=grads["p0"].at[0].set(jnp.inf))
     _, st = scaler.unscale(bad, scaler.init(), store=store)
     assert bool(st.overflow)
+
+
+# -- chunked buckets + interleaved collectives (ISSUE 7) ----------------------
+
+def test_chunked_store_roundtrip_and_caps():
+    """max_bucket_elems splits (dtype, decay) groups into leaf-order
+    chunks: pack/unpack stays the bitwise identity, no chunk exceeds the
+    cap unless a single oversized leaf owns it alone."""
+    tree = {f"l{i}": jnp.asarray(np.random.RandomState(i).randn(7, 3),
+                                 jnp.float32) for i in range(6)}
+    tree["big"] = jnp.asarray(np.random.RandomState(9).randn(40, 3),
+                              jnp.float32)          # 120 > cap: alone
+    cap = 50
+    store = BucketStore(tree, max_bucket_elems=cap)
+    flat = BucketStore(tree)
+    assert flat.n_buckets == 1
+    assert store.n_buckets > flat.n_buckets
+    for b in store.buckets:
+        assert b.size <= cap or len(b.leaf_ids) == 1
+    packed = store.pack(tree)
+    out = store.unpack(packed)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(out[k]),
+                                      np.asarray(tree[k]))
+    # leaf order preserved within the dtype group across chunks
+    seen = [i for b in store.buckets for i in b.leaf_ids]
+    assert seen == sorted(seen)
+
+
+def test_chunked_store_rejects_bad_cap():
+    with pytest.raises(ValueError, match="max_bucket_elems"):
+        BucketStore({"a": jnp.zeros((3,))}, max_bucket_elems=0)
+
+
+def test_reverse_topological_order():
+    """Backward finalizes grads deepest-layer-first (highest flat leaf
+    ids first), so the issue order is descending min-leaf-id: the first
+    bucket psum'd is the one whose grads close earliest."""
+    tree = {f"l{i:02d}": jnp.zeros((10,), jnp.float32) for i in range(8)}
+    store = BucketStore(tree, max_bucket_elems=25)   # chunks of <=2 leaves
+    order = store.reverse_topological_order()
+    assert sorted(order) == list(range(store.n_buckets))
+    mins = [min(store.buckets[bi].leaf_ids) for bi in order]
+    assert mins == sorted(mins, reverse=True)
+    # a flat store degenerates to the single-bucket order
+    assert BucketStore(tree).reverse_topological_order() == (0,)
+
+
+def test_reduce_gradients_chunked_matches_leafwise(dp_mesh):
+    """The interleaved per-chunk psum path (reverse-topological issue
+    order) must be bitwise-identical to the leafwise reduction — the
+    overlap is scheduling, never numerics."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.distributed import reduce_gradients
+    shard_map = jax.shard_map
+
+    rng = np.random.RandomState(31)
+    grads = {f"p{i}": jnp.asarray(rng.randn(N, 6, 5), jnp.float32)
+             for i in range(5)}
+    store = BucketStore(jax.tree_util.tree_map(lambda g: g[:1], grads),
+                        max_bucket_elems=61)        # ~2 leaves per chunk
+    assert store.n_buckets >= 3                      # really interleaved
+
+    def leafwise(g):
+        return reduce_gradients(g, "data")
+
+    def chunked(g):
+        return reduce_gradients(g, "data", bucket_store=store)
+
+    spec = {k: P("data") for k in grads}
+    out_spec = {k: P() for k in grads}
+    run_l = jax.jit(shard_map(leafwise, mesh=dp_mesh, in_specs=(spec,),
+                              out_specs=out_spec, check_vma=False))
+    run_c = jax.jit(shard_map(chunked, mesh=dp_mesh, in_specs=(spec,),
+                              out_specs=out_spec, check_vma=False))
+    o_l, o_c = run_l(grads), run_c(grads)
+    for k in grads:
+        np.testing.assert_array_equal(np.asarray(o_l[k]),
+                                      np.asarray(o_c[k]))

@@ -1,0 +1,159 @@
+"""Warm-start engine tests (ISSUE 7): apex_tpu.cache persistent-cache
+setup + AOT warmup of the StepPipeline device loop.
+
+The acceptance pin: with ``cache.enable`` + ``pipe.warmup`` there are
+ZERO compiles (and zero jit traces) after step 0 — every dispatch goes
+through the AOT executable — and the trajectory is bitwise-identical to
+a cold pipeline's.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import cache, runtime, training
+from apex_tpu.prof import assert_trace_count, trace_count
+from apex_tpu.training import make_train_step
+
+K = 4
+
+
+def _loss_fn(p, b):
+    x, y = b
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+def _fresh_state(init_fn):
+    return init_fn({"w": jnp.ones((8, 4))})
+
+
+def _window(rng, k=K):
+    return (jnp.asarray(rng.randn(k, 16, 8), jnp.float32),
+            jnp.asarray(rng.randn(k, 16, 4), jnp.float32))
+
+
+@pytest.fixture
+def tx_pipe():
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(0.1),
+                                       opt_level="O0")
+    return init_fn, step_fn
+
+
+def test_enable_sets_config_and_creates_dir(tmp_path):
+    d = cache.enable(str(tmp_path / "xla_cache"))
+    assert os.path.isdir(d)
+    assert cache.is_enabled() and cache.cache_dir() == d
+    assert jax.config.jax_compilation_cache_dir == d
+    assert cache.enable(d) == d                      # idempotent
+
+
+def test_persistent_cache_populates_on_compile(tmp_path):
+    d = cache.enable(str(tmp_path / "xla_cache"))
+
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x) @ x
+
+    np.testing.assert_allclose(np.asarray(f(jnp.eye(17))),
+                               np.tanh(np.eye(17)) @ np.eye(17),
+                               atol=1e-6)
+    assert len(os.listdir(d)) > 0, (
+        "persistent compilation cache wrote no entries — "
+        "jax_compilation_cache_dir not honored on this backend")
+
+
+def test_abstractify_pins_only_committed_shardings():
+    x = jnp.ones((4, 4))                             # uncommitted
+    y = jax.device_put(jnp.ones((4,)), jax.devices()[0])   # committed
+    sx, sy = cache.abstractify((x, y))
+    assert isinstance(sx, jax.ShapeDtypeStruct)
+    assert sx.shape == (4, 4) and sx.sharding is None
+    assert sy.sharding == y.sharding
+    # non-array leaves ride through untouched
+    assert cache.abstractify((3, x))[0] == 3
+
+
+def test_signature_matches_runtime_retrace_signature():
+    win = (jnp.zeros((2, 3), jnp.float32), np.ones((2,), np.int32))
+    sig = cache.signature(win)
+    assert sig == ("float32[2, 3]", "int32[2]")
+    assert cache.signature(win) == sig               # stable
+
+
+def test_warmup_zero_traces_and_bitwise_parity(tx_pipe):
+    """The acceptance pin: zero jit traces after warmup (hot AND ragged
+    tail), and the warmed trajectory is bitwise the cold one."""
+    init_fn, step_fn = tx_pipe
+    rng = np.random.RandomState(0)
+    win = _window(rng)
+
+    def run(warm):
+        state = _fresh_state(init_fn)
+        pipe = runtime.StepPipeline(step_fn, K, donate_window=False)
+        if warm:
+            pipe.warmup(state, win, tail=True)
+        for _ in range(3):
+            state, _ = pipe.step_window(state, win)
+        state, metrics = pipe.step_window(state, win, K - 1)   # ragged
+        return pipe, np.asarray(state.params["w"]), jax.device_get(metrics)
+
+    warm_pipe, w_warm, m_warm = run(True)
+    assert_trace_count(warm_pipe.loop, 0)
+    assert_trace_count(warm_pipe.tail_loop, 0)
+    cold_pipe, w_cold, m_cold = run(False)
+    assert trace_count(cold_pipe.loop) >= 1
+    np.testing.assert_array_equal(w_warm, w_cold)
+    np.testing.assert_array_equal(np.ravel(m_warm["loss"]),
+                                  np.ravel(m_cold["loss"]))
+
+
+def test_warmup_from_shape_dtype_structs(tx_pipe):
+    """The declared-(K, shape) form: warmup from ShapeDtypeStructs, no
+    example window materialized (what real-data examples do)."""
+    init_fn, step_fn = tx_pipe
+    state = _fresh_state(init_fn)
+    pipe = runtime.StepPipeline(step_fn, K, donate_window=False)
+    win_sds = (jax.ShapeDtypeStruct((K, 16, 8), jnp.float32),
+               jax.ShapeDtypeStruct((K, 16, 4), jnp.float32))
+    pipe.warmup(state, win_sds)
+    win = _window(np.random.RandomState(1))
+    state, _ = pipe.step_window(state, win)
+    state, _ = pipe.step_window(state, win)
+    assert_trace_count(pipe.loop, 0)
+
+
+def test_unwarmed_signature_falls_back_to_jit(tx_pipe):
+    """A window shape never warmed is a lookup miss, not an error: the
+    jit path traces for it while warmed shapes stay AOT."""
+    init_fn, step_fn = tx_pipe
+    state = _fresh_state(init_fn)
+    pipe = runtime.StepPipeline(step_fn, K, donate_window=False)
+    win = _window(np.random.RandomState(2))
+    pipe.warmup(state, win)
+    state, _ = pipe.step_window(state, win)
+    assert trace_count(pipe.loop) == 0
+    other = (jnp.asarray(np.random.RandomState(3).randn(K, 32, 8),
+                         jnp.float32),
+             jnp.asarray(np.random.RandomState(4).randn(K, 32, 4),
+                         jnp.float32))
+    state, metrics = pipe.step_window(state, other)  # jit path compiles
+    assert trace_count(pipe.loop) == 1
+    assert np.isfinite(np.ravel(jax.device_get(metrics)["loss"])).all()
+
+
+def test_warm_cache_plus_warmup_end_to_end(tmp_path, tx_pipe):
+    """cache.enable + warmup together: the full warm-start recipe the
+    imagenet example ships behind --compilation-cache/--aot-warmup."""
+    cache.enable(str(tmp_path / "xla_cache"))
+    init_fn, step_fn = tx_pipe
+    state = _fresh_state(init_fn)
+    pipe = runtime.StepPipeline(step_fn, K, donate_window=False)
+    win = _window(np.random.RandomState(5))
+    pipe.warmup(state, win)
+    for _ in range(2):
+        state, _ = pipe.step_window(state, win)
+    assert_trace_count(pipe.loop, 0)
+    assert len(os.listdir(cache.cache_dir())) > 0

@@ -200,3 +200,37 @@ def test_lm_example_sequence_parallel(monkeypatch):
         "--synthetic", "--steps", "2", "-b", "2", "--seq-len", "33",
         "--hidden", "32", "--layers", "1", "--heads", "2",
         "--vocab", "128", "--sp", "2", "--attention", "ring"])
+
+
+def test_lm_example_fused_loss_parity(monkeypatch, capsys):
+    """ISSUE 7 satellite: the contrib fused softmax-xentropy (the lm
+    example's default) must produce the SAME loss trajectory as the
+    --no-fused-loss log_softmax reference composition — its vocab-sized
+    logits are the kernel's textbook case, and a trajectory match over
+    real update steps pins forward AND backward parity."""
+    import re
+
+    argv = ["--synthetic", "--steps", "2", "-b", "2", "--seq-len", "33",
+            "--hidden", "32", "--layers", "1", "--heads", "2",
+            "--vocab", "128", "--opt-level", "O2", "--smoothing", "0.1"]
+    _run_example(monkeypatch, "examples/lm/main_amp.py", argv)
+    fused = [float(v) for v in
+             re.findall(r"loss ([\d.]+)", capsys.readouterr().out)]
+    _run_example(monkeypatch, "examples/lm/main_amp.py",
+                 argv + ["--no-fused-loss"])
+    ref = [float(v) for v in
+           re.findall(r"loss ([\d.]+)", capsys.readouterr().out)]
+    assert fused and len(fused) == len(ref)
+    np.testing.assert_allclose(fused, ref, atol=2e-3)
+
+
+def test_imagenet_example_unfused_flags(monkeypatch, capsys):
+    """--no-fused-bn/--no-fused-loss/--no-aot-warmup keep the plain
+    nn.BatchNorm + log_softmax + cold-compile surface alive."""
+    _run_example(monkeypatch, "examples/imagenet/main_amp.py", [
+        "--synthetic", "--prof", "2", "-b", "8", "--image-size", "32",
+        "-a", "resnet18", "--epochs", "1", "--steps-per-epoch", "2",
+        "--opt-level", "O2", "--no-fused-bn", "--no-fused-loss",
+        "--no-aot-warmup"])
+    out = capsys.readouterr().out
+    assert "done" in out

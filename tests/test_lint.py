@@ -707,6 +707,143 @@ def test_j010_string_lower_and_re_compile_pass():
     assert _codes(ok) == []
 
 
+# -- J011: unfused BN/GN + ReLU chains in model bodies (advisory) -------------
+
+def test_j011_nested_bn_relu_flags():
+    bad = """
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.relu(nn.BatchNorm(use_running_average=False)(x))
+    """
+    assert _codes(bad) == ["J011"]
+
+
+def test_j011_consecutive_statements_flag():
+    bad = """
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            y = nn.GroupNorm(num_groups=8)(x)
+            y = nn.relu(y)
+            return y
+    """
+    assert _codes(bad) == ["J011"]
+
+
+def test_j011_partial_and_lambda_norm_aliases_flag():
+    """The factory idiom model bodies actually use (dcgan's lambda,
+    resnet's functools.partial) must not hide the chain."""
+    bad = """
+    import functools
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            norm = functools.partial(nn.BatchNorm,
+                                     use_running_average=not train)
+            x = nn.relu(norm(name="bn0")(x))
+            lnorm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                              name=name)
+            x = nn.relu(lnorm("bn1")(x))
+            return x
+    """
+    assert _codes(bad) == ["J011"]
+
+
+def test_j011_else_branch_chain_flags():
+    """The scan covers every statement list, not just .body — an
+    else-arm bn->relu chain is the same two sweeps (review regression
+    pin)."""
+    bad = """
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        fused: bool = False
+
+        @nn.compact
+        def __call__(self, x):
+            if self.fused:
+                x = x
+            else:
+                y = nn.BatchNorm(use_running_average=False)(x)
+                y = nn.relu(y)
+            return y
+    """
+    assert _codes(bad) == ["J011"]
+
+
+def test_j011_negatives_pass():
+    """leaky_relu has no fused epilogue; an intervening statement breaks
+    the chain; non-__call__ bodies are out of scope."""
+    ok = """
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.leaky_relu(nn.BatchNorm(use_running_average=False)(x),
+                              0.2)
+            y = nn.BatchNorm(use_running_average=False, name="bn2")(x)
+            y = y + x
+            y = nn.relu(y)
+            return y
+
+    def helper(x):
+        return nn.relu(nn.BatchNorm(use_running_average=False)(x))
+    """
+    assert _codes(ok) == []
+
+
+def test_j011_waiver_with_reason_passes():
+    waived = """
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.relu(nn.BatchNorm(use_running_average=False)(x))  # jaxlint: disable=J011 -- fixture: tiny maps below the fusion crossover
+    """
+    assert _codes(waived) == []
+
+
+def test_j011_is_advisory_and_cli_exits_zero(tmp_path):
+    """Advisory contract: the finding renders as [advisory] and an
+    advisory-only file does NOT fail the CLI; mixing in an error-class
+    finding still does."""
+    from tools.jaxlint.linter import Finding
+    src = textwrap.dedent("""
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.relu(nn.BatchNorm(use_running_average=False)(x))
+    """)
+    findings = lint_source(src, "apex_tpu/fixture.py")
+    assert [f.rule for f in findings] == ["J011"]
+    assert findings[0].advisory and "[advisory]" in findings[0].render()
+    assert not Finding("p", 1, 0, "J001", "m").advisory
+
+    adv = tmp_path / "advisory_only.py"
+    adv.write_text(src)
+    assert jaxlint_main([str(adv)]) == 0
+
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(src + textwrap.dedent("""
+    import jax
+
+    def probe(flag):
+        return float(jax.device_get(flag))
+    """))
+    assert jaxlint_main([str(mixed)]) == 1
+
+
 # -- J000: waiver hygiene -----------------------------------------------------
 
 def test_j000_waiver_without_reason_flags_and_waives_nothing():

@@ -35,13 +35,15 @@ PAGES = {
                  "apex_tpu.parallel.pipeline",
                  "apex_tpu.parallel.expert_parallel",
                  "apex_tpu.parallel.zero"],
-    "normalization": ["apex_tpu.normalization"],
+    "normalization": ["apex_tpu.normalization",
+                      "apex_tpu.normalization.fused_bn_act"],
     "ops": ["apex_tpu.ops.flash_attention", "apex_tpu.ops.attention",
             "apex_tpu.ops.losses"],
     "multi_tensor": ["apex_tpu.multi_tensor"],
     "bf16_utils": ["apex_tpu.bf16_utils"],
     "training": ["apex_tpu.training"],
     "runtime": ["apex_tpu.runtime"],
+    "cache": ["apex_tpu.cache"],
     "prof": ["apex_tpu.prof.capture", "apex_tpu.prof.parse",
              "apex_tpu.prof.analysis", "apex_tpu.prof.ledger",
              "apex_tpu.prof.trace_count", "apex_tpu.prof.timeline",

@@ -66,6 +66,17 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   (``apex_tpu.prof.roofline.harvest_costs``) and reuse the result
   (ISSUE 6: the static twin of the roofline engine's harvest-at-trace-
   time contract).
+* **J011** (advisory) unfused BN/GN + ReLU chains in model bodies:
+  ``nn.BatchNorm``/``nn.GroupNorm`` applied and immediately followed by
+  ``nn.relu`` — nested (``nn.relu(nn.BatchNorm(...)(x))``) or as
+  consecutive statements — inside a module ``__call__``.  apex_tpu
+  ships a fused epilogue for exactly this chain
+  (``normalization.bn_relu_residual``, reachable through
+  ``SyncBatchNorm(fuse_relu=True)`` / ``contrib.groupbn.
+  BatchNorm2d_NHWC`` / the ResNet norm-factory hook), which collapses
+  the two elementwise sweeps into one pass (ISSUE 7).  Advisory
+  severity: reported, waivable, and never fails the CLI on its own —
+  the chain is correct, just slower than it needs to be.
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -107,7 +118,14 @@ RULES: Dict[str, str] = {
     "J010": "cost_analysis()/lower()/compile() of a jitted computation "
             "inside a loop (re-traces and recompiles per call; harvest "
             "once before the loop)",
+    "J011": "nn.BatchNorm/nn.GroupNorm immediately followed by nn.relu "
+            "in a model __call__ (a fused apex_tpu epilogue exists; "
+            "advisory)",
 }
+
+#: Rules reported as advice, not errors: the CLI exits 0 when only
+#: advisory findings remain, and ``Finding.advisory`` marks them.
+ADVISORY_RULES: Set[str] = {"J011"}
 
 # Functions whose *contract* is the host boundary: serialization must
 # materialize host values, so J001 does not fire inside them.  Everything
@@ -127,8 +145,14 @@ class Finding(NamedTuple):
     rule: str
     message: str
 
+    @property
+    def advisory(self) -> bool:
+        return self.rule in ADVISORY_RULES
+
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        sev = " [advisory]" if self.advisory else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}{sev} {self.message}")
 
 
 # -- waivers ------------------------------------------------------------------
@@ -669,6 +693,109 @@ def _check_j003(tree: ast.Module, path: str) -> List[Finding]:
                             f"plain Python literal (weak type) or cast the "
                             f"result back"))
     return out
+
+
+# -- J011: unfused BN/GN + ReLU chains in model __call__ bodies ---------------
+
+_J011_NORMS = {"nn.BatchNorm", "nn.GroupNorm", "linen.BatchNorm",
+               "linen.GroupNorm", "flax.linen.BatchNorm",
+               "flax.linen.GroupNorm"}
+_J011_RELUS = {"nn.relu", "jax.nn.relu", "flax.linen.relu"}
+
+
+def _j011_norm_aliases(fn: ast.FunctionDef) -> Set[str]:
+    """Local names bound to a BN/GN factory: ``norm = functools.partial(
+    nn.BatchNorm, ...)`` or ``norm = lambda ...: nn.BatchNorm(...)`` —
+    the idiom model bodies use to parameterize their norm layers."""
+    out: Set[str] = set()
+    for stmt in ast.walk(fn):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        v = stmt.value
+        name = stmt.targets[0].id
+        if isinstance(v, ast.Call) \
+                and _dotted(v.func) in ("functools.partial", "partial") \
+                and v.args and _dotted(v.args[0]) in _J011_NORMS:
+            out.add(name)
+        elif isinstance(v, ast.Lambda) and isinstance(v.body, ast.Call):
+            f = v.body.func
+            if _dotted(f) in _J011_NORMS:
+                out.add(name)
+            elif isinstance(f, ast.Call) and _dotted(f.func) in _J011_NORMS:
+                out.add(name)
+    return out
+
+
+def _j011_is_norm_apply(node: ast.AST, aliases: Set[str]) -> bool:
+    """``nn.BatchNorm(...)(x)`` / ``norm_alias(...)(x)`` /
+    ``norm_alias(x)`` — a BN/GN module applied to activations."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Call):             # ctor-then-apply
+        if _dotted(f.func) in _J011_NORMS:
+            return True
+        if isinstance(f.func, ast.Name) and f.func.id in aliases:
+            return True
+    if isinstance(f, ast.Name) and f.id in aliases:
+        return True
+    return False
+
+
+def _check_j011(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def _report(node: ast.AST, how: str) -> None:
+        findings.append(Finding(
+            path, node.lineno, node.col_offset, "J011",
+            f"BatchNorm/GroupNorm {how} nn.relu in a model __call__ — "
+            f"apex_tpu ships a fused epilogue for this exact chain "
+            f"(normalization.bn_relu_residual via SyncBatchNorm("
+            f"fuse_relu=True) / contrib.groupbn.BatchNorm2d_NHWC / the "
+            f"ResNet norm-factory hook): one elementwise pass instead "
+            f"of two"))
+
+    for fn in ast.walk(tree):
+        if not (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name == "__call__"):
+            continue
+        aliases = _j011_norm_aliases(fn)
+        # nested form: nn.relu(<bn apply>)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and _dotted(node.func) in _J011_RELUS \
+                    and node.args \
+                    and _j011_is_norm_apply(node.args[0], aliases):
+                _report(node, "wrapped directly in")
+        # consecutive-statement form: v = <bn apply>; v = nn.relu(v) —
+        # across EVERY statement list (if/else arms, loop bodies, try/
+        # except/finally), not just .body: an else-branch chain is the
+        # same two sweeps.
+        stmt_lists = []
+        for holder in ast.walk(fn):
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(holder, field, None)
+                if isinstance(body, list) and body \
+                        and isinstance(body[0], ast.stmt):
+                    stmt_lists.append(body)
+        for body in stmt_lists:
+            for prev, nxt in zip(body, body[1:]):
+                if not (isinstance(prev, ast.Assign)
+                        and len(prev.targets) == 1
+                        and isinstance(prev.targets[0], ast.Name)
+                        and _j011_is_norm_apply(prev.value, aliases)):
+                    continue
+                tgt = prev.targets[0].id
+                if not (isinstance(nxt, ast.Assign)
+                        and isinstance(nxt.value, ast.Call)
+                        and _dotted(nxt.value.func) in _J011_RELUS
+                        and nxt.value.args
+                        and isinstance(nxt.value.args[0], ast.Name)
+                        and nxt.value.args[0].id == tgt):
+                    continue
+                _report(nxt.value, "immediately followed by")
+    return findings
 
 
 # -- per-scope walker: J001, J004, J005, J006 ---------------------------------
@@ -1222,6 +1349,7 @@ def lint_source(src: str, path: str = "<string>",
     idx = _ModuleIndex(tree)
     findings += _check_j002(idx, path)
     findings += _check_j003(tree, path)
+    findings += _check_j011(tree, path)
     _ScopeWalker(idx, path, driver, findings).lint_module(tree)
     kept = [f for f in findings if not waivers.waived(f)]
     kept += waivers.errors
