@@ -1,29 +1,79 @@
-"""Checkpoint / resume for train states and amp state.
+"""Checkpoint / resume: single-file states and the async sharded engine.
 
 Reference recipe (SURVEY.md §5, README "Checkpointing"): save model /
 optimizer / amp dicts, restore *after* ``amp.initialize`` with the same
 opt_level; resumed training is bitwise identical
 (``tests/L0/run_amp/test_checkpointing.py:73-199``).
 
-TPU-native form: any pytree (e.g. ``training.TrainState`` — params, opt
-state, scaler state, batch stats) serializes to one ``.npz`` via the native
-flatten path; structure is recorded as key paths so the checkpoint is
-readable without the original treedef.  O2 keeps fp32 masters as the stored
-source of truth, so checkpoints are precision-portable by construction (the
-reference needs an ``O2StateDictHook`` to fake this —
-``_initialize.py:129-138``).
+Two tiers live here (ISSUE 9):
+
+* the **v1 single-file path** — :func:`save_checkpoint` /
+  :func:`load_checkpoint` serialize any pytree (e.g.
+  ``training.TrainState``) to one ``.npz`` with key-path structure, the
+  simple synchronous recipe for small states and unit tests;
+* the **v2 elastic engine** — :class:`CheckpointManager` snapshots the
+  live state to host at a window boundary (non-blocking ``device_get``:
+  every leaf's D2H copy is *started* before the first one is awaited),
+  then serializes + fsyncs on a background writer thread with atomic
+  rename, per-host sharded files, a JSON manifest (tree paths, dtypes,
+  world shape, per-file checksums, flat-bucket layout), and a retention
+  policy — the Check-N-Run decoupled snapshot-then-persist shape, so
+  the train loop stalls only for the copy trigger (gated in
+  ``bench.py`` self-validation: async stall <= 20% of the synchronous
+  write).  :func:`load_checkpoint_dir` restores the newest *valid*
+  checkpoint (corrupt / truncated / mid-write ``.tmp`` remains are
+  skipped, falling back to the previous step) and reshards zero1
+  ``bucketed=True`` flat buckets on read when the resume world's shard
+  count differs from the save world's — the first concrete elastic
+  resize path.
+
+O2 keeps fp32 masters as the stored source of truth, so checkpoints are
+precision-portable by construction (the reference needs an
+``O2StateDictHook`` to fake this — ``_initialize.py:129-138``).
+
+Usage (the examples' ``--checkpoint-dir/--checkpoint-every/--resume``)::
+
+    mgr = checkpoint.CheckpointManager(dir, keep=3, every_steps=500)
+    restored = mgr.restore(like=init_state)      # None on a fresh start
+    ...
+    for window ...:
+        state, metrics = pipe.step_window(state, window, n)
+        mgr.maybe_save(step, state, loader_state=stream.state_dict())
+    mgr.save(step, state, block=True)            # final, synchronous
+    mgr.close()
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import queue
+import re
+import shutil
+import threading
+import time
+import uuid
+import zlib
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
 
+from . import telemetry as _telemetry
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager",
+           "Restored", "load_checkpoint_dir", "latest_checkpoint",
+           "list_checkpoints", "bucket_layout", "CheckpointError"]
+
 
 _DTYPE_TAG = "@dtype="
+_JSON_PREFIX = "__extrajson__/"
+_STEP_DIR_RE = re.compile(r"^step_(\d{8,})$")
+_MANIFEST_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written or no valid one could be read."""
 
 
 def _encode(arr: np.ndarray):
@@ -42,12 +92,16 @@ def _decode(arr: np.ndarray, dtype_name):
     return arr.view(np.dtype(getattr(ml_dtypes, dtype_name, dtype_name)))
 
 
+def _path_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
 def _flatten_with_paths(tree):
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     out = {}
     for path, leaf in flat:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
+        key = _path_key(path)
         arr, tag = _encode(np.asarray(jax.device_get(leaf)))  # jaxlint: disable=J001 -- checkpoint serialization materializes host arrays by contract
         if tag is not None:
             key = key + _DTYPE_TAG + tag
@@ -55,31 +109,110 @@ def _flatten_with_paths(tree):
     return out
 
 
+def _snapshot_with_paths(tree, own=None):
+    """Host snapshot of ``tree``'s leaves with the v1 key encoding, but
+    with every owned leaf's device→host copy STARTED before the first
+    one is awaited (``copy_to_host_async``), so the total stall is one
+    overlapped transfer instead of a serial per-leaf drain.  ``own``
+    filters leaves by flat index (per-host sharding); None takes all."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    picked = [(i, _path_key(path), leaf)
+              for i, (path, leaf) in enumerate(flat)
+              if own is None or own(i)]
+    for _, _, leaf in picked:
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:
+                pass            # the blocking fetch below still works
+    out = {}
+    for _, key, leaf in picked:
+        arr, tag = _encode(np.asarray(jax.device_get(leaf)))  # jaxlint: disable=J001 -- the checkpoint snapshot IS the sanctioned host materialization; copies were started async above
+        if tag is not None:
+            key = key + _DTYPE_TAG + tag
+        out[key] = arr
+    return out
+
+
+# -- extras: explicit scalar/str round-trip (ISSUE 9 satellite) ---------------
+
+def _encode_extra(key: str, value):
+    """Encode one ``**extra`` value for npz storage.
+
+    Arrays and bare numeric scalars keep the historical array path
+    (``int(extra["step"])`` round-trips exactly as before); ``str`` /
+    ``bool`` / ``None`` and nested dicts/lists travel as tagged JSON —
+    ``np.asarray`` on those either crashes under ``allow_pickle=False``
+    (None → object array) or munges the python type on reload.  Returns
+    ``(npz_key, array)``; raises ``TypeError`` for values that fit
+    neither route."""
+    if isinstance(value, (bool, str)) or value is None \
+            or isinstance(value, (dict, list, tuple)):
+        try:
+            payload = json.dumps(value)
+        except (TypeError, ValueError) as e:
+            raise TypeError(
+                f"checkpoint extra {key!r} is not serializable: {e} — "
+                f"pass arrays, numeric scalars, or JSON-compatible "
+                f"values") from e
+        return (_JSON_PREFIX + key,
+                np.frombuffer(payload.encode("utf-8"), np.uint8))
+    arr = np.asarray(value)
+    if arr.dtype == object:
+        raise TypeError(
+            f"checkpoint extra {key!r} has object dtype "
+            f"({type(value).__name__}) — pass arrays, numeric scalars, "
+            f"or JSON-compatible values")
+    return key, arr
+
+
+def _decode_extras(raw: dict) -> dict:
+    out = {}
+    for k, v in raw.items():
+        if k.startswith(_JSON_PREFIX):
+            out[k[len(_JSON_PREFIX):]] = json.loads(
+                bytes(np.asarray(v, np.uint8)).decode("utf-8"))
+        else:
+            out[k] = v
+    return out
+
+
+def _place_like(arr: np.ndarray, leaf):
+    """Device-place a restored host array onto the template leaf's
+    sharding (ISSUE 9 satellite): a resumed mesh run must get its state
+    back SHARDED, not silently un-sharded host numpy.  Only committed
+    shardings are honored — an uncommitted default-device leaf keeps the
+    old behavior (plain ``jnp.asarray``)."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and getattr(leaf, "committed", False):
+        return jax.device_put(arr, sharding)
+    return jax.numpy.asarray(arr)
+
+
 def save_checkpoint(path: str, state, amp_state: Optional[dict] = None,
                     **extra) -> None:
     """Serialize ``state`` (any pytree) + optional amp ``state_dict`` to
-    ``path`` (.npz)."""
+    ``path`` (.npz).  ``extra`` values may be arrays, numeric scalars,
+    or JSON-compatible python values (str/bool/None/dict/list) — all
+    round-trip through :func:`load_checkpoint` with their python types
+    intact."""
     arrays = _flatten_with_paths(state)
     if amp_state:
         for k, v in _flatten_with_paths(amp_state).items():
             arrays["__amp__/" + k] = v
     for k, v in extra.items():
-        arrays["__extra__/" + k] = np.asarray(v)
+        ek, ev = _encode_extra(k, v)
+        arrays["__extra__/" + ek] = ev
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
     os.replace(tmp, path)         # atomic publish
 
 
-def load_checkpoint(path: str, like):
-    """Restore a pytree shaped like ``like`` from ``path``; returns
-    ``(state, amp_state_dict, extra_dict)``.  Dtypes/shapes must match the
-    template (same opt_level rule as the reference recipe)."""
-    with np.load(path, allow_pickle=False) as data:
-        arrays = {k: data[k] for k in data.files}
-    amp_state = {}
-    extra = {}
-    plain = {}
+def _split_raw_arrays(arrays: dict):
+    """Split a loaded key->array dict into (plain, amp, extra_raw)."""
+    amp_state, extra_raw, plain = {}, {}, {}
     for k, v in arrays.items():
         if _DTYPE_TAG in k:
             k, tag = k.split(_DTYPE_TAG, 1)
@@ -87,38 +220,646 @@ def load_checkpoint(path: str, like):
         if k.startswith("__amp__/"):
             amp_state[k[len("__amp__/"):]] = v
         elif k.startswith("__extra__/"):
-            extra[k[len("__extra__/"):]] = v
+            extra_raw[k[len("__extra__/"):]] = v
         else:
             plain[k] = v
+    return plain, amp_state, extra_raw
 
+
+def _padded_flat_len(size: int, num_shards: int) -> int:
+    """The zero1 flat-bucket padding rule — delegated to
+    :func:`apex_tpu.multi_tensor.buckets.padded_shard_len`, the single
+    definition state init and reshard-on-read both use."""
+    from .multi_tensor.buckets import padded_shard_len
+    return padded_shard_len(size, num_shards)
+
+
+def _maybe_reshard_flat(arr: np.ndarray, want_shape, key: str,
+                        buckets: Optional[dict]):
+    """Reshard a zero1 flat-bucket leaf on read: a checkpoint saved at
+    shard count N stores each bucket's optimizer-state leaves padded to
+    ``_padded_flat_len(size, N)``; restoring at M != N re-slices to the
+    bucket's TRUE size (from the manifest's bucket layout) and re-pads
+    to the template's length.  Returns the resharded array, or None when
+    the mismatch is not a recorded bucket (caller raises).
+
+    The bucket is identified by its INDEX parsed from the leaf's key
+    path when possible (``.../inner/<i>/...`` — zero1 keeps one inner
+    state per bucket, in store order): two buckets whose true sizes
+    collide under the old padding would otherwise match the wrong size
+    and silently zero real moment values.  The padded-size scan is only
+    the fallback for layouts whose paths carry no index."""
+    if not buckets or arr.ndim != 1 or len(want_shape) != 1:
+        return None
+    old_n = int(buckets.get("num_shards", 0))
+    if old_n < 1:
+        return None
+    want = int(want_shape[0])
+    sizes = [int(s) for s in buckets.get("sizes", ())]
+
+    def _fits(true_size):
+        return (_padded_flat_len(true_size, old_n) == arr.size
+                and want >= true_size)
+
+    candidates = []
+    for seg in key.split("/"):
+        if seg.isdigit() and int(seg) < len(sizes):
+            candidates.append(sizes[int(seg)])
+    candidates += sizes                 # fallback: padded-size scan
+    for true_size in candidates:
+        if _fits(true_size):
+            out = arr[:true_size]
+            if want > true_size:
+                out = np.concatenate(
+                    [out, np.zeros((want - true_size,), arr.dtype)])
+            return out
+    return None
+
+
+def _rebuild(plain: dict, like, *, buckets: Optional[dict] = None,
+             context: str = "checkpoint"):
+    """Match ``plain`` (key -> host array) against the template ``like``
+    and rebuild the pytree, validating dtypes, resharding flat buckets,
+    and device-placing each leaf onto the template's sharding."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
     consumed = set()
     leaves = []
     for path_elems, leaf in flat:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path_elems)
+        key = _path_key(path_elems)
         if key not in plain:
-            raise KeyError(f"checkpoint missing leaf {key!r}")
+            raise KeyError(f"{context} missing leaf {key!r}")
         consumed.add(key)
         arr = plain[key]
-        # jaxlint: disable=J001 -- restore-time dtype validation reads the template leaf once per checkpoint load
-        want_dtype = np.asarray(jax.device_get(leaf)).dtype \
-            if hasattr(leaf, "dtype") else None
+        # Validation reads only the template's STATIC aval (dtype/shape)
+        # — never its values, so a donated-and-deleted template leaf or
+        # a jax.ShapeDtypeStruct template validates fine and the load
+        # pays no D2H transfer of the template tree.
+        want_dtype = (np.dtype(leaf.dtype) if hasattr(leaf, "dtype")
+                      else None)
         if want_dtype is not None and arr.dtype != want_dtype:
             raise ValueError(
                 f"dtype mismatch for {key!r}: checkpoint {arr.dtype}, "
                 f"template {want_dtype} — restore with the same opt_level "
                 f"used at save time (reference checkpointing rule)")
-        leaves.append(jax.numpy.asarray(arr))
+        want_shape = (tuple(leaf.shape) if hasattr(leaf, "shape")
+                      else None)
+        if want_shape is not None and arr.shape != want_shape:
+            resharded = _maybe_reshard_flat(arr, want_shape, key, buckets)
+            if resharded is None:
+                raise ValueError(
+                    f"shape mismatch for {key!r}: checkpoint {arr.shape}, "
+                    f"template {want_shape} — not a recorded flat bucket, "
+                    f"so elastic resharding cannot apply")
+            arr = resharded
+        leaves.append(_place_like(arr, leaf))
     unconsumed = set(plain) - consumed
     if unconsumed:
-        # A checkpoint from a larger/renamed model would otherwise appear to
-        # load while silently dropping state (ADVICE r1 #5).
+        # A checkpoint from a larger/renamed model would otherwise appear
+        # to load while silently dropping state (ADVICE r1 #5).
         raise KeyError(
-            "checkpoint holds {} array(s) with no matching template leaf "
+            "{} holds {} array(s) with no matching template leaf "
             "(e.g. {!r}) — the template pytree does not match the model "
-            "that was saved".format(len(unconsumed),
+            "that was saved".format(context, len(unconsumed),
                                     sorted(unconsumed)[0]))
-    state = jax.tree_util.tree_unflatten(
-        treedef, leaves)
-    return state, amp_state, extra
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def load_checkpoint(path: str, like):
+    """Restore a pytree shaped like ``like`` from ``path``; returns
+    ``(state, amp_state_dict, extra_dict)``.  Dtypes/shapes must match
+    the template (same opt_level rule as the reference recipe); every
+    restored leaf is device-placed onto the template leaf's sharding
+    when that sharding is committed, so resuming on a mesh keeps the
+    state sharded."""
+    with np.load(path, allow_pickle=False) as data:
+        arrays = {k: data[k] for k in data.files}
+    plain, amp_state, extra_raw = _split_raw_arrays(arrays)
+    state = _rebuild(plain, like)
+    return state, amp_state, _decode_extras(extra_raw)
+
+
+# -- v2: sharded directory layout ---------------------------------------------
+
+def _step_dir_name(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def _shard_file_name(shard: int, n_shards: int) -> str:
+    return f"shard_{shard:05d}_of_{n_shards:05d}.npz"
+
+
+def _manifest_file_name(shard: int, n_shards: int) -> str:
+    return f"manifest_{shard:05d}_of_{n_shards:05d}.json"
+
+
+def _crc32_file(path: str) -> str:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def bucket_layout(store, num_shards: int) -> dict:
+    """Manifest descriptor of a zero1 ``bucketed=True`` run's flat
+    buckets: the per-bucket TRUE element counts (pre-padding) plus the
+    shard count the optimizer state was padded for.  Recorded by
+    :meth:`CheckpointManager.save` so :func:`load_checkpoint_dir` can
+    re-slice the buckets when the resume world's shard count differs —
+    build it from the SAME :class:`~apex_tpu.multi_tensor.BucketStore`
+    the optimizer packs with (delegates to
+    :meth:`~apex_tpu.multi_tensor.BucketStore.shard_layout`)."""
+    return store.shard_layout(num_shards)
+
+
+class Restored(NamedTuple):
+    """One restored v2 checkpoint."""
+    state: Any
+    amp_state: dict
+    extra: dict
+    loader_state: Optional[dict]
+    step: int
+    run_id: Optional[str] = None
+
+
+def list_checkpoints(directory: str):
+    """Sorted ``(step, step_dir)`` pairs found under ``directory``
+    (no validation — see :func:`latest_checkpoint`)."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _STEP_DIR_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def _validate_step_dir(step_dir: str) -> Optional[dict]:
+    """Validate one step directory: every manifest part present, every
+    shard file present with a matching checksum.  Returns the merged
+    manifest dict, or None when the checkpoint is unusable (mid-write
+    crash leaving ``.tmp`` files, truncated/corrupted shards, missing
+    parts)."""
+    manifests = []
+    try:
+        names = os.listdir(step_dir)
+    except OSError:
+        return None
+    for name in names:
+        if name.startswith("manifest_") and name.endswith(".json"):
+            try:
+                with open(os.path.join(step_dir, name),
+                          encoding="utf-8") as f:
+                    manifests.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                return None
+    if not manifests:
+        return None
+    n_shards = manifests[0].get("n_shards")
+    if len(manifests) != n_shards:
+        return None               # a host's part never landed
+    merged = {"parts": sorted(manifests, key=lambda m: m.get("shard", 0)),
+              "step": manifests[0].get("step"),
+              "version": manifests[0].get("version")}
+    if merged["version"] is None or merged["version"] > _MANIFEST_VERSION:
+        return None
+    for part in merged["parts"]:
+        fpath = os.path.join(step_dir, part.get("file", ""))
+        if not os.path.isfile(fpath):
+            return None
+        try:
+            if _crc32_file(fpath) != part.get("file_crc32"):
+                return None
+        except OSError:
+            return None
+    return merged
+
+
+def _find_latest_valid(directory: str):
+    """Newest valid step dir AND its merged manifest (so callers that
+    immediately load don't pay a second full-CRC validation pass)."""
+    for step, step_dir in reversed(list_checkpoints(directory)):
+        manifest = _validate_step_dir(step_dir)
+        if manifest is not None:
+            return step_dir, manifest
+    return None, None
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest VALID step directory under ``directory`` (manifest parts
+    complete, shard checksums pass), or None.  Invalid newest steps —
+    a mid-write crash's ``.tmp`` leftovers, a truncated shard — fall
+    back to the previous valid step instead of failing the resume."""
+    return _find_latest_valid(directory)[0]
+
+
+def load_checkpoint_dir(path: str, like, *, step: Optional[int] = None):
+    """Restore a :class:`Restored` from a v2 checkpoint directory.
+
+    ``path`` may be the checkpoint root (the newest valid step is
+    chosen, or ``step`` pins one) or a single ``step_*`` directory.
+    Every shard file is read and merged, leaves are validated against
+    ``like`` (dtype + shape), flat buckets recorded in the manifest's
+    bucket layout are resharded when the template's padded length
+    differs (elastic zero1 resume), and each leaf is device-placed onto
+    the template's committed sharding."""
+    step_dir, manifest = path, None
+    if not _STEP_DIR_RE.match(os.path.basename(os.path.normpath(path))):
+        if step is not None:
+            step_dir = os.path.join(path, _step_dir_name(step))
+        else:
+            # newest-valid search hands back the manifest it already
+            # built, so the shards are CRC-read once here, not twice
+            step_dir, manifest = _find_latest_valid(path)
+            if step_dir is None:
+                raise CheckpointError(
+                    f"no valid checkpoint under {path!r}")
+    if manifest is None:
+        manifest = _validate_step_dir(step_dir)
+    if manifest is None:
+        raise CheckpointError(
+            f"checkpoint {step_dir!r} is missing, incomplete, or fails "
+            f"its checksums")
+    arrays: dict = {}
+    for part in manifest["parts"]:
+        fpath = os.path.join(step_dir, part["file"])
+        with np.load(fpath, allow_pickle=False) as data:
+            for k in data.files:
+                arrays[k] = data[k]
+    plain, amp_state, extra_raw = _split_raw_arrays(arrays)
+    part0 = manifest["parts"][0]
+    buckets = part0.get("buckets")
+    state = _rebuild(plain, like, buckets=buckets,
+                     context=f"checkpoint {os.path.basename(step_dir)}")
+    extra = dict(part0.get("extra") or {})
+    extra.update(_decode_extras(extra_raw))
+    return Restored(state=state, amp_state=amp_state, extra=extra,
+                    loader_state=part0.get("loader"),
+                    step=int(manifest["step"]),
+                    run_id=part0.get("run_id"))
+
+
+class _Pending(NamedTuple):
+    step: int
+    arrays: dict              # key -> host np array (this shard's leaves)
+    manifest: dict            # this shard's manifest part (sans checksums)
+    done: threading.Event
+    t_enqueue: float
+
+
+class CheckpointManager:
+    """Async, sharded, elastic checkpoint engine (ISSUE 9 tentpole).
+
+    * **Async snapshot** — :meth:`save` copies the state to host (every
+      leaf's D2H copy started before the first await) and returns; a
+      background writer thread serializes, fsyncs, and atomically
+      publishes (``.tmp`` → ``os.replace``, manifest last), so the train
+      loop stalls only for the copy trigger.  ``block=True`` (or
+      ``async_write=False``) keeps the whole write on the caller — the
+      final drain checkpoint and the bench's sync baseline.
+    * **Per-host sharded layout** — with ``procs=(index, count)`` (default
+      ``jax.process_index()/process_count()``) each host writes only the
+      leaves it owns (round-robin over the flat leaf order) as
+      ``shard_<i>_of_<n>.npz`` plus its manifest part; a checkpoint is
+      valid only when every part landed and every checksum passes.
+    * **Retention** — ``keep`` newest valid checkpoints survive; older
+      step directories are pruned after each successful publish.
+    * **Elastic resume** — pass ``bucket_layout=``
+      (:func:`bucket_layout`) on save so a zero1 ``bucketed=True``
+      state restores at a different shard count (the manifest records
+      each bucket's true size; :func:`load_checkpoint_dir` re-slices).
+
+    Telemetry: with a recorder active, every save emits ``checkpoint``
+    span events (``phase`` = snapshot / serialize / commit / error /
+    backlog) the watchdog's ``checkpoint_stall`` / ``checkpoint_failed``
+    rules fold (``docs/telemetry.md``).
+
+    Writer errors never kill the training loop mid-save: they are
+    recorded (and emitted as ``checkpoint`` ``phase="error"`` events)
+    and re-raised from the next :meth:`save` / :meth:`wait` /
+    :meth:`close` so the failure is surfaced on the caller's thread.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3,
+                 every_steps: Optional[int] = None,
+                 async_write: bool = True,
+                 procs: Optional[Tuple[int, int]] = None,
+                 run_id: Optional[str] = None,
+                 max_pending: int = 2, fsync: bool = True,
+                 telemetry=None):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if every_steps is not None and every_steps < 1:
+            raise ValueError(
+                f"every_steps must be >= 1, got {every_steps}")
+        self.directory = directory
+        self.keep = int(keep)
+        self.every_steps = every_steps
+        self.async_write = bool(async_write)
+        if procs is None:
+            procs = (jax.process_index(), jax.process_count())
+        index, count = int(procs[0]), int(procs[1])  # jaxlint: disable=J001 -- procs is a (index, count) pair of host ints, never a device value
+        if not 0 <= index < count:
+            raise ValueError(f"procs index {index} not in [0, {count})")
+        self.procs = (index, count)
+        if run_id is None:
+            rec = _telemetry.get_recorder()
+            run_id = getattr(rec, "run_id", None) or uuid.uuid4().hex[:12]
+        self.run_id = run_id
+        self.max_pending = max(1, int(max_pending))
+        self.fsync = bool(fsync)
+        self._telemetry = telemetry
+        self._last_saved: Optional[int] = None
+        self._error: Optional[BaseException] = None
+        self._q: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        self._closed = False
+        # step dirs already proven valid: a committed checkpoint is
+        # immutable, so prune never re-reads (re-CRCs) its shards —
+        # without this every publish would re-checksum `keep`
+        # checkpoints' worth of bytes off disk.
+        self._known_valid: set = set()
+        os.makedirs(directory, exist_ok=True)
+
+    # -- telemetry ----------------------------------------------------------
+    def _rec(self):
+        return (self._telemetry if self._telemetry is not None
+                else _telemetry.get_recorder())
+
+    def _event(self, phase: str, **fields) -> None:
+        rec = self._rec()
+        if rec is not None:
+            rec.event("checkpoint", phase=phase, **fields)
+
+    # -- cadence ------------------------------------------------------------
+    @property
+    def last_saved(self) -> Optional[int]:
+        return self._last_saved
+
+    def maybe_save(self, step: int, state, **kw) -> bool:
+        """Save iff ``every_steps`` is set and ``step`` has advanced at
+        least that far past the last save (the StepPipeline window-hook
+        cadence; a fresh run's cadence anchors at step 0, so the first
+        save lands AT ``every_steps``, keeping save steps on the same
+        grid across kill/resume cycles).  Returns True when a save was
+        triggered."""
+        if self.every_steps is None:
+            return False
+        if step - (self._last_saved or 0) < self.every_steps:
+            return False
+        self.save(step, state, **kw)
+        return True
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state, *, amp_state: Optional[dict] = None,
+             loader_state: Optional[dict] = None,
+             bucket_layout: Optional[dict] = None,
+             block: bool = False, **extra) -> None:
+        """Checkpoint ``state`` at ``step``.
+
+        The caller pays only the host snapshot (overlapped D2H copies of
+        this host's leaves); serialization, fsync, atomic publish, and
+        retention pruning run on the writer thread.  ``block=True``
+        forces the whole write on the caller (the drain checkpoint).
+        ``extra`` round-trips like :func:`save_checkpoint` extras."""
+        self._raise_pending_error()
+        if self._closed:
+            raise CheckpointError("CheckpointManager is closed")
+        index, count = self.procs
+        t0 = time.perf_counter()
+        arrays = _snapshot_with_paths(
+            state, own=(None if count == 1
+                        else (lambda i: i % count == index)))
+        if amp_state and index == 0:
+            for k, v in _flatten_with_paths(amp_state).items():
+                arrays["__amp__/" + k] = v
+        if index == 0:
+            for k, v in extra.items():
+                ek, ev = _encode_extra(k, v)
+                arrays["__extra__/" + ek] = ev
+        snap_s = time.perf_counter() - t0
+        nbytes = int(sum(a.nbytes for a in arrays.values()))
+        self._event("snapshot", step=int(step), dur=round(snap_s, 6),
+                    bytes=nbytes, shard=index)
+        manifest = {
+            "format": "apex_tpu-ckpt-v2",
+            "version": _MANIFEST_VERSION,
+            "step": int(step),
+            "shard": index, "n_shards": count,
+            "file": _shard_file_name(index, count),
+            "run_id": self.run_id,
+            "world": {"process_count": count,
+                      "device_count": jax.device_count()},
+            "wall_time": time.time(),
+            "leaves": {k: {"shape": list(v.shape), "dtype": v.dtype.name}
+                       for k, v in arrays.items()},
+        }
+        if index == 0:
+            manifest["loader"] = loader_state
+            manifest["buckets"] = bucket_layout
+            # JSON-safe extras ride in the manifest too (human-readable
+            # `cat manifest.json`); the npz keys stay authoritative.
+            manifest["extra"] = {
+                k: v for k, v in extra.items()
+                if isinstance(v, (str, bool, int, float, type(None)))}
+        pending = _Pending(step=int(step), arrays=arrays,
+                           manifest=manifest, done=threading.Event(),
+                           t_enqueue=time.perf_counter())
+        self._last_saved = int(step)
+        if block or not self.async_write:
+            self.wait()            # order after (and never race) the
+            self._write_one(pending)   # writer thread's pending steps
+            self._raise_pending_error()
+            return
+        self._ensure_writer()
+        backlog = self._q.qsize()
+        if backlog >= self.max_pending:
+            # Bound host memory: a writer that cannot keep up with the
+            # save cadence stalls the trigger here — visible to the
+            # watchdog as a checkpoint backlog.
+            self._event("backlog", step=int(step), value=backlog)
+            while self._q.qsize() >= self.max_pending \
+                    and self._writer is not None \
+                    and self._writer.is_alive():
+                time.sleep(0.005)
+        self._q.put(pending)
+
+    def _ensure_writer(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="apex-tpu-ckpt-writer")
+            self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if item.manifest.get("__fence__"):
+                item.done.set()    # a wait() marker, nothing to write
+                continue
+            try:
+                self._write_one(item)
+            except BaseException as e:   # surfaced on the caller's thread
+                self._error = e
+                self._event("error", step=item.step,
+                            error=f"{type(e).__name__}: {e}")
+            finally:
+                item.done.set()
+
+    def _fsync(self, f) -> None:
+        if self.fsync:
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _write_one(self, pending: _Pending) -> None:
+        index, count = pending.manifest["shard"], pending.manifest["n_shards"]
+        step_dir = os.path.join(self.directory,
+                                _step_dir_name(pending.step))
+        os.makedirs(step_dir, exist_ok=True)
+        t0 = time.perf_counter()
+        shard_path = os.path.join(step_dir, pending.manifest["file"])
+        tmp = shard_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **pending.arrays)
+            self._fsync(f)
+        os.replace(tmp, shard_path)
+        manifest = dict(pending.manifest)
+        manifest["file_bytes"] = os.path.getsize(shard_path)
+        manifest["file_crc32"] = _crc32_file(shard_path)
+        self._event("serialize", step=pending.step,
+                    dur=round(time.perf_counter() - t0, 6),
+                    bytes=manifest["file_bytes"], shard=index)
+        mpath = os.path.join(step_dir, _manifest_file_name(index, count))
+        mtmp = mpath + ".tmp"
+        with open(mtmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1)
+            self._fsync(f)
+        os.replace(mtmp, mpath)     # the commit point for this shard
+        self._event("commit", step=pending.step, shard=index,
+                    dur=round(time.perf_counter() - pending.t_enqueue, 6))
+        if index == 0:
+            self._prune()
+        pending.done.set()
+
+    def _prune(self) -> None:
+        """Keep the ``keep`` newest VALID checkpoints; drop the rest
+        (and any step directory older than the newest valid ones that
+        never became valid — a crashed write's debris)."""
+        entries = list_checkpoints(self.directory)
+        valid = []
+        for s, sd in entries:
+            if sd in self._known_valid \
+                    or _validate_step_dir(sd) is not None:
+                self._known_valid.add(sd)
+                valid.append((s, sd))
+        if not valid:
+            return
+        survivors = valid[-self.keep:]
+        oldest_kept = survivors[0][0]
+        keep_dirs = {sd for _, sd in survivors}
+        for s, step_dir in entries:
+            # Only prune strictly OLDER than the retention window: an
+            # invalid NEWER dir may be a checkpoint another host is
+            # still committing, never debris to delete from here.
+            if step_dir in keep_dirs or s >= oldest_kept:
+                continue
+            try:
+                shutil.rmtree(step_dir)
+                self._known_valid.discard(step_dir)
+                self._event("prune", path=os.path.basename(step_dir))
+            except OSError:
+                pass
+
+    # -- restore ------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        found = latest_checkpoint(self.directory)
+        if found is None:
+            return None
+        return int(_STEP_DIR_RE.match(os.path.basename(found)).group(1))
+
+    def restore(self, like, *, step: Optional[int] = None,
+                required: bool = False) -> Optional[Restored]:
+        """Restore the newest valid checkpoint (or ``step``) against the
+        template ``like``; returns None when the directory holds no
+        valid checkpoint (fresh start) unless ``required``."""
+        self.wait()
+        t0 = time.perf_counter()
+        try:
+            restored = load_checkpoint_dir(self.directory, like, step=step)
+        except CheckpointError:
+            if required:
+                raise
+            return None
+        self._event("restore", step=restored.step,
+                    dur=round(time.perf_counter() - t0, 6))
+        self._last_saved = restored.step
+        if restored.run_id:
+            # Adopt the saved run's identity: subsequent saves (and the
+            # caller's telemetry stream, if it copies mgr.run_id) stay
+            # attributable to ONE logical run across interruptions.
+            self.run_id = restored.run_id
+        return restored
+
+    # -- lifecycle ----------------------------------------------------------
+    def _raise_pending_error(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointError(
+                f"checkpoint writer failed: {type(err).__name__}: {err}"
+            ) from err
+
+    @property
+    def pending(self) -> int:
+        """Writes enqueued but not yet published."""
+        return self._q.qsize()
+
+    #: how long wait()/close() give the writer before declaring it
+    #: wedged (hung storage) — a silent timeout here would let a drain
+    #: report "checkpoint saved" with nothing published.
+    drain_timeout_s: float = 300.0
+
+    def wait(self) -> None:
+        """Block until every enqueued write has published; re-raises a
+        writer failure on this thread, and raises if the writer is
+        wedged (no progress within ``drain_timeout_s`` — hung NFS and
+        the like) instead of returning as if the write landed."""
+        if self._writer is not None and self._writer.is_alive():
+            fence = threading.Event()
+            self._q.put(_Pending(step=-1, arrays={}, manifest={
+                "shard": self.procs[0], "n_shards": self.procs[1],
+                "file": "", "__fence__": True}, done=fence,
+                t_enqueue=time.perf_counter()))
+            if not fence.wait(timeout=self.drain_timeout_s):
+                raise CheckpointError(
+                    f"checkpoint writer did not drain within "
+                    f"{self.drain_timeout_s:.0f}s — storage is hung or "
+                    f"the writer is wedged; pending checkpoints are NOT "
+                    f"published")
+        self._raise_pending_error()
+
+    def close(self) -> None:
+        """Drain pending writes and stop the writer thread.  Idempotent;
+        re-raises a writer failure, and raises if the writer never
+        exits (wedged storage) rather than pretending the drain
+        finished."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._writer is not None and self._writer.is_alive():
+            self._q.put(None)
+            self._writer.join(timeout=self.drain_timeout_s)
+            if self._writer.is_alive():
+                raise CheckpointError(
+                    f"checkpoint writer still running after "
+                    f"{self.drain_timeout_s:.0f}s at close — storage is "
+                    f"hung; pending checkpoints are NOT published")
+        self._raise_pending_error()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -281,6 +281,39 @@ class PrefetchLoader:
             except queue.Full:
                 pass
 
+    def state_dict(self) -> dict:
+        """Resume state of this loader (ISSUE 9): ``delivered`` counts
+        the batches the CONSUMER actually received — the prefetch
+        pipeline runs ahead of it, so the source's own cursor includes
+        in-flight batches that were pulled but never trained on.  When
+        the source implements the resume protocol
+        (:class:`DirectoryImagenet`), ``source`` carries its
+        ``state_dict(consumed=delivered)`` — i.e. the source state
+        rewound to the delivery boundary; rebuild the stream, ``resume``
+        it with that dict, and wrap it in a fresh loader.
+
+        Requires ``ordered=True``: under completion-order delivery the
+        delivered batches are NOT a prefix of the source order, so no
+        integer cursor can rewind to the delivery boundary — resuming
+        from one would skip undelivered early batches and replay
+        delivered ones.  Raises instead of silently losing data."""
+        if not self._ordered:
+            raise ValueError(
+                "PrefetchLoader.state_dict() needs ordered=True: "
+                "completion-order delivery has no prefix cursor, so a "
+                "delivered-count resume would skip in-flight batches "
+                "and replay delivered ones — run resumable jobs with "
+                "ordered delivery")
+        delivered = self.stats.batches
+        out = {"delivered": int(delivered)}
+        sd = getattr(self._it, "state_dict", None)
+        if sd is not None:
+            try:
+                out["source"] = sd(consumed=delivered)
+            except TypeError:       # source counts items itself
+                out["source"] = sd()
+        return out
+
     def __enter__(self) -> "PrefetchLoader":
         return self
 
@@ -471,7 +504,10 @@ class BatchFiles(NamedTuple):
     :func:`load_batch` (typically inside a ``transform``).  ``seq`` is
     the batch's global sequence number (monotonic ACROSS epochs): mix it
     into any per-batch augmentation seed so a batch led by the same file
-    in two epochs still draws fresh crops/flips."""
+    in two epochs still draws fresh crops/flips.  ``seq`` equals the
+    producing stream's cursor, so a resumed run
+    (:meth:`DirectoryImagenet.resume`) re-yields the SAME descriptor —
+    augment draws replay bit-identically (ISSUE 9)."""
     paths: Tuple[str, ...]
     labels: np.ndarray            # int32 [batch]
     image_size: int
@@ -501,15 +537,201 @@ def load_batch(task: BatchFiles) -> Tuple[np.ndarray, np.ndarray]:
     return imgs, task.labels
 
 
+class DirectoryImagenet:
+    """Resumable batch stream over an ImageNet-style directory —
+    the class behind :func:`directory_imagenet` (ISSUE 9: deterministic
+    full-run resume needs the input stream to be a *cursor* over a
+    deterministic schedule, not an anonymous generator).
+
+    Everything that determines the batch sequence is derived from the
+    constructor arguments plus one integer — ``cursor``, the count of
+    batches this host has already yielded.  Epoch index, the per-epoch
+    shuffle (``RandomState(seed + epoch)``), the host-shard slice, and
+    the global ``seq`` (== cursor, the augment-seed input) all fall out
+    of it, so :meth:`state_dict` / :meth:`resume` round-trip a
+    kill-and-resume run onto the bit-identical remaining stream — and
+    :meth:`skip` fast-forwards by index math alone, no decode.
+
+    Iteration semantics match the historical generator exactly: the
+    object is its own single-pass iterator (``next()`` and ``for``
+    share one position), ``close()`` releases the decode pool.
+    """
+
+    def __init__(self, root: str, batch_size: int, image_size: int = 224,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = True, workers: int = 8,
+                 epochs: Optional[int] = 1, decode: bool = True,
+                 host_shard: Union[None, bool, Tuple[int, int]] = None):
+        import os
+
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class subdirectories under {root}")
+        class_idx = {c: i for i, c in enumerate(classes)}
+        samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                if f.lower().endswith((".npy", ".jpg", ".jpeg", ".png")):
+                    samples.append((os.path.join(cdir, f), class_idx[c]))
+        if not samples:
+            raise ValueError(f"no samples under {root}")
+        if host_shard is True:
+            host_shard = (jax.process_index(), jax.process_count())
+        if host_shard is not None:
+            index, count = host_shard
+            if not 0 <= index < count:
+                raise ValueError(
+                    f"host_shard index {index} not in [0, {count})")
+        else:
+            index, count = 0, 1
+        self._samples = samples
+        self.batch_size = int(batch_size)
+        self.image_size = int(image_size)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.drop_last = bool(drop_last)
+        self.workers = int(workers)
+        self.decode = bool(decode)
+        self.host_shard = (index, count)
+        self.epochs = epochs
+        stop = (len(samples) - batch_size + 1) if drop_last \
+            else len(samples)
+        starts = range(0, stop, batch_size)
+        # Truncate to a multiple of ``count`` batches so every host gets
+        # EXACTLY the same number per epoch (SPMD lockstep: one extra
+        # step on some hosts deadlocks the collectives at the epoch
+        # boundary), then slice this host's every-count-th batch.
+        usable = len(starts) - len(starts) % count
+        self._local_starts = list(starts)[index:usable:count]
+        #: local batches already yielded (this host's stream position;
+        #: also the BatchFiles.seq of the NEXT batch).
+        self.cursor = 0
+        self._epoch_cached: Optional[int] = None
+        self._epoch_samples = None
+        self._pool = None
+        self._closed = False
+
+    # -- resume protocol ----------------------------------------------------
+    @property
+    def batches_per_epoch(self) -> int:
+        return len(self._local_starts)
+
+    def state_dict(self, consumed: Optional[int] = None) -> dict:
+        """The stream's resume state.  ``consumed`` overrides the cursor
+        with the count of batches the TRAINING LOOP has consumed — under
+        a :class:`PrefetchLoader` the stream runs ahead by the prefetch
+        depth, and resuming from the stream's own cursor would skip the
+        in-flight batches that were pulled but never trained on."""
+        cursor = self.cursor if consumed is None else int(consumed)
+        return {"cursor": cursor, "seed": self.seed,
+                "shuffle": self.shuffle,
+                "batch_size": self.batch_size,
+                "host_shard": list(self.host_shard),
+                "batches_per_epoch": self.batches_per_epoch,
+                "n_samples": len(self._samples)}
+
+    def resume(self, state: dict) -> "DirectoryImagenet":
+        """Position this stream at ``state``'s cursor.  The recorded
+        schedule parameters must match this stream's — a resume against
+        a different dataset/seed/shard layout would silently replay the
+        WRONG batches, so it raises instead."""
+        for key, mine in (("seed", self.seed), ("shuffle", self.shuffle),
+                          ("batch_size", self.batch_size),
+                          ("host_shard", list(self.host_shard)),
+                          ("batches_per_epoch", self.batches_per_epoch),
+                          ("n_samples", len(self._samples))):
+            if key in state and state[key] != mine:
+                raise ValueError(
+                    f"loader resume mismatch: checkpoint {key}="
+                    f"{state[key]!r}, stream has {mine!r} — the resumed "
+                    f"stream must be built with the same dataset and "
+                    f"schedule arguments as the saved run")
+        self.cursor = int(state["cursor"])
+        return self
+
+    def skip(self, n_batches: int) -> "DirectoryImagenet":
+        """Fast-forward ``n_batches`` (index math only — no decode)."""
+        self.cursor += int(n_batches)
+        return self
+
+    # -- iteration ----------------------------------------------------------
+    def _epoch_order(self, epoch: int):
+        if self._epoch_cached != epoch:
+            if self.shuffle:
+                order = np.random.RandomState(
+                    self.seed + epoch).permutation(len(self._samples))
+                self._epoch_samples = [self._samples[i] for i in order]
+            else:
+                self._epoch_samples = self._samples
+            self._epoch_cached = epoch
+        return self._epoch_samples
+
+    def _release_pool(self, wait: bool) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=wait)
+            self._pool = None
+
+    def close(self) -> None:
+        """Release the decode pool (matches the old generator's
+        ``close()``); iteration after close yields nothing."""
+        self._closed = True
+        self._release_pool(wait=False)
+
+    def __iter__(self) -> "DirectoryImagenet":
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        bpe = self.batches_per_epoch
+        if bpe == 0 or (self.epochs is not None
+                        and self.cursor >= self.epochs * bpe):
+            # Exhaustion releases the decode threads like the old
+            # generator's ExitStack did (long-lived jobs build a fresh
+            # stream per epoch — idle pools must not accumulate); the
+            # object stays usable: resume()/skip() back into range
+            # lazily rebuilds the pool.
+            self._release_pool(wait=True)
+            raise StopIteration
+        epoch, pos = divmod(self.cursor, bpe)
+        epoch_samples = self._epoch_order(epoch)
+        i = self._local_starts[pos]
+        batch = epoch_samples[i:i + self.batch_size]
+        labels = np.asarray([l for _, l in batch], np.int32)
+        seq = self.cursor
+        self.cursor += 1
+        if not self.decode:
+            return BatchFiles(tuple(p for p, _ in batch), labels,
+                              self.image_size, seq)
+        paths = (p for p, _ in batch)
+        if self.workers > 1 and self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=self.workers)
+        if self._pool is not None:
+            imgs = np.stack(list(self._pool.map(
+                lambda p: _load_image(p, self.image_size), paths)))
+        else:
+            imgs = np.stack([_load_image(p, self.image_size)
+                             for p in paths])
+        return imgs, labels
+
+
 def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
                        shuffle: bool = True, seed: int = 0,
                        drop_last: bool = True, workers: int = 8,
                        epochs: Optional[int] = 1, decode: bool = True,
                        host_shard: Union[None, bool,
-                                         Tuple[int, int]] = None):
+                                         Tuple[int, int]] = None
+                       ) -> DirectoryImagenet:
     """Stream batches from an ImageNet-style directory:
     ``root/<class_name>/*.{npy,jpg,jpeg,png}``.  ``.npy`` files must hold
-    HWC uint8; JPEG/PNG files decode via PIL.
+    HWC uint8; JPEG/PNG files decode via PIL.  Returns a
+    :class:`DirectoryImagenet` — iterate it like the historical
+    generator, or drive the resume protocol
+    (``state_dict()``/``resume()``/``skip()``) for deterministic
+    kill-and-resume (ISSUE 9).
 
     * ``epochs`` — iterate the dataset this many times (``None`` =
       forever) with a fresh shuffle each epoch (``RandomState(seed +
@@ -536,70 +758,11 @@ def directory_imagenet(root: str, batch_size: int, image_size: int = 224,
     decode engine (the reference leans on DALI for full-rate ImageNet,
     ``examples/imagenet/main_amp.py:262-310``); the benchmarked input
     paths are ``.npy`` and :func:`synthetic_imagenet`."""
-    import contextlib
-    import itertools
-    import os
-    from concurrent.futures import ThreadPoolExecutor
-
-    classes = sorted(d for d in os.listdir(root)
-                     if os.path.isdir(os.path.join(root, d)))
-    if not classes:
-        raise ValueError(f"no class subdirectories under {root}")
-    class_idx = {c: i for i, c in enumerate(classes)}
-    samples = []
-    for c in classes:
-        cdir = os.path.join(root, c)
-        for f in sorted(os.listdir(cdir)):
-            if f.lower().endswith((".npy", ".jpg", ".jpeg", ".png")):
-                samples.append((os.path.join(cdir, f), class_idx[c]))
-    if not samples:
-        raise ValueError(f"no samples under {root}")
-    if host_shard is True:
-        host_shard = (jax.process_index(), jax.process_count())
-    if host_shard is not None:
-        index, count = host_shard
-        if not 0 <= index < count:
-            raise ValueError(f"host_shard index {index} not in [0, {count})")
-    else:
-        index, count = 0, 1
-
-    stop = (len(samples) - batch_size + 1) if drop_last else len(samples)
-    epoch_it = itertools.count() if epochs is None else range(epochs)
-    seq = 0                       # global batch counter, across epochs
-    with contextlib.ExitStack() as stack:
-        pool = None
-        if decode and workers > 1:
-            pool = stack.enter_context(ThreadPoolExecutor(
-                max_workers=workers))
-        for epoch in epoch_it:
-            if shuffle:
-                order = np.random.RandomState(seed + epoch).permutation(
-                    len(samples))
-                epoch_samples = [samples[i] for i in order]
-            else:
-                epoch_samples = samples
-            starts = range(0, stop, batch_size)
-            # Truncate to a multiple of ``count`` batches so every host
-            # gets EXACTLY the same number per epoch (SPMD lockstep: one
-            # extra step on some hosts deadlocks the collectives at the
-            # epoch boundary).
-            usable = len(starts) - len(starts) % count
-            for i in itertools.islice(starts, index, usable, count):
-                batch = epoch_samples[i:i + batch_size]
-                labels = np.asarray([l for _, l in batch], np.int32)
-                seq += 1
-                if not decode:
-                    yield BatchFiles(tuple(p for p, _ in batch), labels,
-                                     image_size, seq - 1)
-                    continue
-                paths = (p for p, _ in batch)
-                if pool is not None:
-                    imgs = np.stack(list(pool.map(
-                        lambda p: _load_image(p, image_size), paths)))
-                else:
-                    imgs = np.stack([_load_image(p, image_size)
-                                     for p in paths])
-                yield imgs, labels
+    return DirectoryImagenet(root, batch_size, image_size=image_size,
+                             shuffle=shuffle, seed=seed,
+                             drop_last=drop_last, workers=workers,
+                             epochs=epochs, decode=decode,
+                             host_shard=host_shard)
 
 
 def synthetic_imagenet(batch_size: int, image_size: int = 224,

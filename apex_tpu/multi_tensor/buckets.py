@@ -49,7 +49,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["BucketStore", "Packed", "cached_store"]
+__all__ = ["BucketStore", "Packed", "cached_store", "padded_shard_len"]
+
+
+def padded_shard_len(size: int, num_shards: int) -> int:
+    """Length of a flat bucket padded to divide evenly over
+    ``num_shards`` — THE padding rule shared by ``zero1`` state init,
+    the checkpoint manifest's bucket layout, and elastic
+    reshard-on-read (``apex_tpu.checkpoint``).  A single definition:
+    a drift between writer and reader would corrupt resumed moments."""
+    return -(-int(size) // int(num_shards)) * int(num_shards)
 
 
 def cached_store(cell: dict, template, **kwargs) -> "BucketStore":
@@ -363,6 +372,16 @@ class BucketStore:
         return tuple(sorted(
             range(len(self.buckets)),
             key=lambda bi: -min(self.buckets[bi].leaf_ids)))
+
+    def shard_layout(self, num_shards: int) -> dict:
+        """Checkpoint-manifest descriptor of this store's buckets for a
+        zero1 run sharded ``num_shards`` ways: the per-bucket TRUE
+        element counts plus the shard count the optimizer state is
+        padded for (:func:`padded_shard_len`).  Recorded at save time so
+        ``apex_tpu.checkpoint`` can re-slice the flat buckets when the
+        resume world's shard count differs (elastic resize)."""
+        return {"sizes": [int(s) for s in self.sizes],
+                "num_shards": int(num_shards)}
 
     def leaf_order(self) -> Tuple[int, ...]:
         """Float-leaf indices in flattened-tree order — for reassembling

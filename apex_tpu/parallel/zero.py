@@ -180,9 +180,12 @@ def zero1(tx, axis_name: str, *, num_shards: int, bucketed: bool = False):
             "or set elementwise=True on your FunctionalOptimizer if its "
             "update truly treats every element independently")
 
+    from ..multi_tensor.buckets import padded_shard_len
+
     def _padded_len(n_elems):
-        chunk = -(-n_elems // num_shards)
-        return chunk * num_shards
+        # The SAME rule the checkpoint manifest's bucket layout records
+        # (elastic reshard-on-read re-slices against it).
+        return padded_shard_len(n_elems, num_shards)
 
     if bucketed:
         from ..multi_tensor.buckets import BucketStore, cached_store

@@ -74,7 +74,8 @@ def set_recorder(rec: Optional["Recorder"]) -> Optional["Recorder"]:
     return prev
 
 
-def start(path: str, watchdog: bool = False, **meta) -> "Recorder":
+def start(path: str, watchdog: bool = False,
+          run_id: Optional[str] = None, **meta) -> "Recorder":
     """Open a recorder on ``path`` and install it as the active one.
     Keyword args land in the stream's leading ``run`` event.
 
@@ -82,8 +83,14 @@ def start(path: str, watchdog: bool = False, **meta) -> "Recorder":
     (:mod:`apex_tpu.telemetry.watchdog`): events are folded online on
     the emitting thread and debounced ``alert`` events land in the same
     stream; read ``rec.watchdog.format_line()`` at exit for the
-    one-line health summary."""
-    rec = Recorder(path, meta=meta or None)
+    one-line health summary.
+
+    ``run_id`` names the run across interruptions (ISSUE 9): a resumed
+    process passes the id restored from its checkpoint so the resumed
+    stream is attributable to the same logical run; omitted, a fresh id
+    is generated.  Either way it rides the ``run`` event and
+    ``rec.run_id``."""
+    rec = Recorder(path, meta=meta or None, run_id=run_id)
     if watchdog:
         from .watchdog import attach
         attach(rec)
@@ -122,7 +129,13 @@ class Recorder:
     """
 
     def __init__(self, path_or_file: Union[str, IO], *,
-                 meta: Optional[dict] = None, reservoir: int = 512):
+                 meta: Optional[dict] = None, reservoir: int = 512,
+                 run_id: Optional[str] = None):
+        import uuid
+        #: stable identifier of the LOGICAL run — survives kill/resume
+        #: when the resuming process passes the checkpointed id back
+        #: through ``telemetry.start(run_id=...)`` (ISSUE 9).
+        self.run_id = run_id or uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
         if hasattr(path_or_file, "write"):
             self._f, self._owns, self.path = path_or_file, False, None
@@ -144,7 +157,7 @@ class Recorder:
         self._last_scale: Optional[float] = None
         #: optional run-health rule engine (attach_watchdog / watchdog.attach)
         self._watchdog = None
-        self.event("run", meta=meta or {})
+        self.event("run", run_id=self.run_id, meta=meta or {})
 
     # -- core sink ----------------------------------------------------------
     @property

@@ -41,6 +41,11 @@ Rules (each a small stateful fold; thresholds are constructor kwargs):
 ``retrace_storm``         >= ``storm_count`` TRUE retraces (never-seen shape
                           signatures — the J004 class) within
                           ``storm_steps`` steps
+``checkpoint_stall``      a ``checkpoint`` snapshot span exceeded
+                          ``ckpt_stall_s`` (the async engine's stall
+                          contract broke) or the writer reported a backlog
+``checkpoint_failed``     a checkpoint write errored — the newest recovery
+                          point is stale (critical)
 ========================  =====================================================
 
 Usage — the examples' ``--watchdog`` flag does exactly this::
@@ -62,7 +67,7 @@ from .metrics import Rolling
 __all__ = ["Watchdog", "attach", "RULE_NAMES"]
 
 RULE_NAMES = ("nonfinite", "scale_collapse", "loader_stall", "step_time",
-              "retrace_storm")
+              "retrace_storm", "checkpoint_stall", "checkpoint_failed")
 
 
 class _Rule:
@@ -247,6 +252,64 @@ class _RetraceStorm(_Rule):
         return None
 
 
+class _CheckpointStall(_Rule):
+    """The async checkpoint engine's stall contract (ISSUE 9): the
+    train loop should pay only the snapshot's D2H copy.  Fires when a
+    ``checkpoint`` ``snapshot`` span exceeds ``ckpt_stall_s`` (the
+    serialize/fsync work leaked back onto the loop thread, or the copy
+    itself is drowning), or on a ``backlog`` event (the writer thread
+    cannot keep up with the save cadence and the trigger is now
+    blocking to bound host memory)."""
+
+    name = "checkpoint_stall"
+
+    def __init__(self, ckpt_stall_s: float = 2.0):
+        self.ckpt_stall_s = ckpt_stall_s
+
+    def observe(self, event):
+        if event.get("kind") != "checkpoint":
+            return None
+        phase = event.get("phase")
+        if phase == "backlog":
+            return {"step": event.get("step"),
+                    "value": event.get("value"),
+                    "message": f"checkpoint writer backlog "
+                               f"({event.get('value')} pending) — the "
+                               f"save cadence outruns the writer thread "
+                               f"and the snapshot trigger is blocking"}
+        if phase != "snapshot":
+            return None
+        dur = float(event.get("dur", 0.0))
+        if dur > self.ckpt_stall_s:
+            return {"step": event.get("step"), "value": round(dur, 3),
+                    "message": f"checkpoint snapshot stalled the loop "
+                               f"{dur:.2f}s (> {self.ckpt_stall_s:.1f}s) "
+                               f"— the D2H copy trigger is no longer "
+                               f"cheap (serialize leaked onto the loop "
+                               f"thread, or the state outgrew the link)"}
+        return None
+
+
+class _CheckpointFailed(_Rule):
+    """A checkpoint write failed (ISSUE 9) — the run is still training
+    but its recovery point is stale; every further step widens the loss
+    a preemption would cause.  Critical, debounced like the rest."""
+
+    name = "checkpoint_failed"
+    severity = "critical"
+
+    def observe(self, event):
+        if event.get("kind") != "checkpoint" \
+                or event.get("phase") != "error":
+            return None
+        return {"step": event.get("step"),
+                "value": event.get("error"),
+                "message": f"checkpoint write FAILED "
+                           f"({event.get('error')}) — the newest "
+                           f"recovery point is stale; fix storage or "
+                           f"drain now"}
+
+
 class Watchdog:
     """Folds recorder events through the rule set and emits debounced
     ``alert`` events back into the same stream.
@@ -276,6 +339,9 @@ class Watchdog:
                 _RetraceStorm(
                     storm_count=thresholds.get("storm_count", 3),
                     storm_steps=thresholds.get("storm_steps", 128)),
+                _CheckpointStall(
+                    ckpt_stall_s=thresholds.get("ckpt_stall_s", 2.0)),
+                _CheckpointFailed(),
             ]
         self.rules = rules
         self.alerts: List[Dict[str, Any]] = []

@@ -187,6 +187,15 @@ _ADAM_DEEP_SPEEDUP_GATE = 2.0
 _TEL_OVERHEAD_GATE = 1.5
 _TEL_STALL_TOL_PCT = 2.0
 
+# ISSUE 9 (elastic runtime): the async checkpoint engine's stall
+# contract — the train loop pays only the snapshot's D2H copy, the
+# serialize+fsync rides the writer thread.  Gate: async stall per step
+# <= 20% of the synchronous write's, measured on the SAME loop/state
+# (the gate only arms when the sync stall is big enough to measure —
+# below the floor the division is host-scheduler noise).
+_CKPT_ASYNC_OVER_SYNC_GATE = 0.20
+_CKPT_SYNC_FLOOR_MS = 1.0
+
 
 def _gate_implied(name, implied, peak, measured_max):
     if implied >= peak:
@@ -1124,6 +1133,133 @@ def _bench_telemetry():
     }
 
 
+def _bench_checkpoint():
+    """ISSUE 9 self-validation: measure ``checkpoint_stall_ms_per_step``
+    on one pipelined training loop under three regimes — no
+    checkpointing (the wall baseline), the SYNCHRONOUS write (serialize
+    + fsync on the loop thread, the v1 shape), and the ASYNC engine
+    (snapshot trigger only; serialize/fsync on the writer thread).
+
+    The stall is the summed ON-LOOP-THREAD duration of the save
+    triggers divided by steps — a direct measurement of the engine's
+    contract ("the loop pays only the snapshot"), robust to host
+    contention: a wall-clock difference would also charge the async
+    writer's background CPU time to the loop on a CPU backend (where
+    XLA compute and the writer share cores), which is exactly the
+    regime CI runs this probe in.  Whole-pass walls are recorded for
+    context.  main() gates async <= 20% of sync (when sync is
+    measurable), and every checkpoint either regime produced must
+    validate + restore bitwise against the live state."""
+    import shutil
+    import tempfile
+
+    from apex_tpu import checkpoint as ckpt_mod
+    from apex_tpu import runtime, training
+    from apex_tpu.training import make_train_step
+
+    k, n_windows, reps = 4, 8, 3
+    # One save per timed pass: a cadence that outruns the writer thread
+    # degrades async to sync THROUGH the backpressure path by design —
+    # the stall gate measures the sustainable-cadence contract, and the
+    # backlog case is the watchdog's checkpoint_stall rule's job.
+    save_every = n_windows * k
+    rs = np.random.RandomState(0)
+    # ~8 MB of fp32 params -> ~32 MB serialized per save under O2
+    # (masters + two moments + model copy): enough that a synchronous
+    # npz+fsync visibly stalls the loop.
+    w0 = rs.randn(1024, 2048).astype(np.float32) / 45.0
+    batches = [(rs.randn(16, 1024).astype(np.float32),
+                rs.randn(16, 2048).astype(np.float32))
+               for _ in range(n_windows * k)]
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    def one_run(mode):
+        init_fn, step_fn = make_train_step(
+            loss_fn, training.sgd(lr=0.01), opt_level="O2",
+            loss_scale="dynamic")
+        pipe = runtime.StepPipeline(step_fn, k)
+        state = init_fn({"w": jnp.asarray(w0)})
+        ck_dir = tempfile.mkdtemp(prefix=f"apex_tpu_bench_ckpt_{mode}_")
+        mgr = None
+        if mode != "none":
+            mgr = ckpt_mod.CheckpointManager(
+                ck_dir, every_steps=save_every, keep=2,
+                async_write=(mode == "async"))
+
+        gstep = {"n": 0}                        # cumulative across passes
+        acc = {"save_s": 0.0, "saves": 0}       # loop-thread trigger time
+
+        def one_pass(state):
+            t0 = time.perf_counter()
+            for window, n_valid in runtime.window_batches(
+                    iter(batches), k):
+                state, metrics = pipe.step_window(state, window, n_valid)
+                gstep["n"] += n_valid
+                if mgr is not None:
+                    # cumulative step: the cadence keeps saving on every
+                    # timed pass, not only the first.  The time THIS
+                    # call holds the loop thread IS the stall under
+                    # measurement (sync: snapshot+serialize+fsync;
+                    # async: snapshot + any backpressure).
+                    ts = time.perf_counter()
+                    if mgr.maybe_save(gstep["n"], state):
+                        acc["save_s"] += time.perf_counter() - ts
+                        acc["saves"] += 1
+            _force(metrics)                     # fence the pipeline
+            return time.perf_counter() - t0, state
+
+        _, state = one_pass(state)              # compile pass
+        acc["save_s"], acc["saves"] = 0.0, 0    # exclude the compile pass
+        best = float("inf")
+        for _ in range(reps):
+            dt, state = one_pass(state)
+            best = min(best, dt)
+        restored_ok = True
+        if mgr is not None:
+            # the trailing async writes finish OFF the timed loop; the
+            # published checkpoint must still validate and restore the
+            # live state bitwise
+            if mgr.last_saved != gstep["n"]:
+                mgr.save(gstep["n"], state, block=True)
+            mgr.wait()
+            restored = mgr.restore(like=state)
+            restored_ok = restored is not None and all(
+                np.array_equal(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32))
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(restored.state)),
+                    jax.tree_util.tree_leaves(jax.device_get(state))))
+            mgr.close()
+        shutil.rmtree(ck_dir, ignore_errors=True)
+        stall_ms = (acc["save_s"] / (reps * n_windows * k) * 1e3
+                    if acc["saves"] else 0.0)
+        return best, stall_ms, acc["saves"], restored_ok
+
+    steps = n_windows * k
+    t_none, _, _, _ = one_run("none")
+    t_sync, sync_stall, sync_saves, sync_ok = one_run("sync")
+    t_async, async_stall, async_saves, async_ok = one_run("async")
+    return {
+        "steps_per_pass": steps,
+        "save_every_steps": save_every,
+        "saves_timed": {"sync": sync_saves, "async": async_saves},
+        "baseline_wall_s": round(t_none, 4),
+        "sync_wall_s": round(t_sync, 4),
+        "async_wall_s": round(t_async, 4),
+        "checkpoint_stall_ms_per_step_sync": round(sync_stall, 3),
+        "checkpoint_stall_ms_per_step_async": round(async_stall, 3),
+        "async_over_sync": (round(async_stall / sync_stall, 3)
+                            if sync_stall > 0 else None),
+        "async_over_sync_gate": _CKPT_ASYNC_OVER_SYNC_GATE,
+        "sync_floor_ms": _CKPT_SYNC_FLOOR_MS,
+        "restore_bitwise_ok": bool(sync_ok and async_ok),
+    }
+
+
 def _bench_examples(on_tpu):
     """Execute the flagship example entry points and distill their own
     printed metrics.  Gates: the run completed, every printed loss is
@@ -1756,6 +1892,30 @@ def main():
             f"{_TEL_STALL_TOL_PCT} points — the stream and "
             f"format_loader_line no longer share one snapshot; refusing "
             f"to report.")
+
+    # Async-checkpoint self-validation (ISSUE 9), backend-independent:
+    # the engine's whole point is that the loop pays only the snapshot
+    # trigger — if the async stall creeps toward the synchronous
+    # write's, serialization is back on the loop thread.
+    extra["checkpoint"] = ckpt_v = _bench_checkpoint()
+    if not ckpt_v["restore_bitwise_ok"]:
+        raise SystemExit(
+            "BENCH SELF-CHECK FAILED: a checkpoint written during the "
+            "stall probe did not restore bitwise against the live "
+            "state — the async writer is publishing corrupt or stale "
+            "snapshots; refusing to report.")
+    if (ckpt_v["checkpoint_stall_ms_per_step_sync"]
+            >= _CKPT_SYNC_FLOOR_MS
+            and ckpt_v["async_over_sync"] is not None
+            and ckpt_v["async_over_sync"] > _CKPT_ASYNC_OVER_SYNC_GATE):
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: async checkpoint stall is "
+            f"{ckpt_v['async_over_sync']}x the synchronous write's "
+            f"(> {_CKPT_ASYNC_OVER_SYNC_GATE}x gate; "
+            f"async {ckpt_v['checkpoint_stall_ms_per_step_async']} vs "
+            f"sync {ckpt_v['checkpoint_stall_ms_per_step_sync']} "
+            f"ms/step) — serialize/fsync leaked back onto the train "
+            f"loop; refusing to report.")
 
     # Self-validation, same contract as the MFU gates above: a steady
     # rate far below the example's own best window means the hot loop is

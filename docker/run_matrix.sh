@@ -30,7 +30,12 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # no-pallas tiers must stay numerically identical.  test_cache.py rides
 # for the warm-start engine (AOT warmup is pure host machinery — every
 # tier must keep zero-compile-after-step-0 and bitwise parity).
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py -q"
+# test_checkpoint.py + test_faultinject.py ride for the elastic
+# fault-tolerant runtime (ISSUE 9): serialization, manifest validation,
+# and kill-and-resume bit-parity are pure host + XLA machinery, so
+# every degradation tier must recover identically (the faultinject
+# children inherit the tier env vars through the harness).
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

@@ -77,6 +77,22 @@ def parse():
                    help="run the reference-parity imperative amp surface "
                    "(amp.initialize num_losses=3 + scale_loss loss_id + "
                    "FusedAdam.step) instead of the pipelined runtime")
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="async sharded checkpointing of the full GAN "
+                        "state (both parameter trees, both Adam states, "
+                        "all three scalers) every --checkpoint-every "
+                        "iters at window boundaries (pipelined mode)")
+    p.add_argument("--checkpoint-every", type=int, default=100,
+                   help="save cadence in iters (window-boundary floored)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid checkpoint under "
+                        "--checkpoint-dir (pipelined mode)")
+    p.add_argument("--drain", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="graceful SIGTERM/SIGINT drain (ON by default): "
+                        "finish the window, write a final checkpoint, "
+                        "flush the recorder; second signal hard-stops")
     p.add_argument("--telemetry", type=str, default=None, metavar="PATH",
                    help="record the run-telemetry event stream (JSONL) "
                    "to PATH; analyze offline with "
@@ -228,6 +244,32 @@ def main_pipelined(opt):
         }
         return new_state, metrics
 
+    # Elastic checkpoint/resume + preemption drain (ISSUE 9): the whole
+    # functional carry — both parameter trees, both Adam states, all
+    # three loss-scale machines — is one pytree, so the manager
+    # checkpoints GAN training with the same code path as the others.
+    mgr = None
+    start_step = 0
+    if opt.checkpoint_dir:
+        from apex_tpu import checkpoint as apex_checkpoint
+        mgr = apex_checkpoint.CheckpointManager(
+            opt.checkpoint_dir,
+            every_steps=max(1, opt.checkpoint_every))
+        if opt.resume:
+            restored = mgr.restore(like=state)
+            if restored is not None:
+                state = restored.state
+                start_step = restored.step
+                from apex_tpu import telemetry
+                rec = telemetry.get_recorder()
+                if rec is not None:
+                    rec.run_id = mgr.run_id
+                    rec.event("resume", run_id=mgr.run_id,
+                              step=start_step)
+                print(f"resumed at iter {start_step} "
+                      f"(run {mgr.run_id}) from {opt.checkpoint_dir}")
+    stop = runtime.GracefulShutdown().install() if opt.drain else None
+
     spc = max(1, opt.steps_per_call)
     total = opt.niter * opt.iters_per_epoch
     # Reused pool window: spc distinct pool batches stacked once — must
@@ -260,8 +302,8 @@ def main_pipelined(opt):
               f"Loss_G: {np.ravel(vals['loss_g'])[last]:.4f}")
 
     ci = 0
-    while reader.steps_pushed < total:
-        n_valid = min(spc, total - reader.steps_pushed)
+    while start_step + reader.steps_pushed < total:
+        n_valid = min(spc, total - start_step - reader.steps_pushed)
         state, metrics = pipe.step_window(state, window, n_valid)
         prev = reader.push(metrics, n_valid)
         if ci <= 1:
@@ -275,10 +317,31 @@ def main_pipelined(opt):
                 and (prev.step // spc) % print_every == 0:
             emit(prev)
         ci += 1
+        gstep = start_step + reader.steps_pushed
+        if stop is not None and stop.draining:
+            if mgr is not None:
+                mgr.save(gstep, state, block=True)
+            print(f"drain: stopping at iter {gstep} ({stop.reason})")
+            break
+        if mgr is not None:
+            mgr.maybe_save(gstep, state)
     if reader.newest() is not None:
         emit(reader.newest())             # doubles as the pipeline drain
+    if mgr is not None:
+        gstep = start_step + reader.steps_pushed
+        if mgr.last_saved != gstep:
+            mgr.save(gstep, state, block=True)
+        mgr.close()
+        print(f"checkpoint: iter {gstep} saved under "
+              f"{opt.checkpoint_dir}")
+    if stop is not None:
+        stop.uninstall()
     t1 = time.perf_counter()
-    n_steady = total - warm_iters
+    # ACTUAL iterations dispatched (a drain break stops early — dividing
+    # the planned total by the short wall would inflate the it/s lines
+    # bench.py parses)
+    n_done = reader.steps_pushed
+    n_steady = n_done - warm_iters
     if t_steady is not None and n_steady > 0:
         print(f"steady {n_steady / (t1 - t_steady):.2f} it/s over "
               f"{n_steady} iters (excl first 2 calls)")
@@ -299,7 +362,7 @@ def main_pipelined(opt):
     # Parsed by bench.py into loader_stall_pct: the pool is fully
     # pre-staged, so by construction the loop never waits on input.
     print("loader: stall 0.00% (pre-staged synthetic pool)")
-    print(f"done in {t1 - t0:.1f}s ({total / (t1 - t0):.2f} it/s)")
+    print(f"done in {t1 - t0:.1f}s ({n_done / (t1 - t0):.2f} it/s)")
 
 
 # -- imperative mode: the reference-parity amp surface ------------------------
@@ -485,6 +548,11 @@ def main_imperative(opt):
 
 def main():
     opt = parse()
+    if opt.imperative and (opt.checkpoint_dir or opt.resume):
+        raise SystemExit(
+            "--checkpoint-dir/--resume need the pipelined default (the "
+            "functional state carry is what the manager snapshots); "
+            "drop --imperative")
     rec = None
     use_watchdog = (opt.watchdog if opt.watchdog is not None
                     else bool(opt.telemetry))

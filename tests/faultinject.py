@@ -1,0 +1,242 @@
+"""Fault-injection harness (ISSUE 9): kill a training subprocess at
+randomized steps — SIGTERM (graceful drain) and SIGKILL (hard crash,
+possibly mid-checkpoint-write) — resume it, and assert the resumed
+trajectory is BITWISE identical to an uninterrupted run.
+
+Two halves:
+
+* the **child trainer** (``python tests/faultinject.py --dir ...``): a
+  deterministic little amp-O2 training loop on the real runtime stack —
+  :class:`apex_tpu.runtime.StepPipeline` windows,
+  :class:`apex_tpu.checkpoint.CheckpointManager` every ``--save-every``
+  steps, :class:`apex_tpu.runtime.GracefulShutdown` drain — whose batch
+  for global step *s* is a pure function of *s*, so any resume point
+  replays the identical remaining stream.  Progress lines (``step N``)
+  let the parent target a kill step; the final state serializes to
+  ``--out`` with a ``FINAL N`` marker.
+* the **harness functions** (:func:`run_child`, :func:`run_and_kill`)
+  used by ``tests/test_faultinject.py`` — they launch the child with
+  ``JAX_PLATFORMS=cpu``, watch stdout, deliver the signal at the chosen
+  step, and return the transcript.
+
+Window alignment note: the child keeps every checkpointable step on the
+K-step window grid (``--save-every`` a multiple of ``--spc``, total
+steps too), so a resumed run rebuilds the same full windows the
+uninterrupted run executed — the bit-parity claim then compares the
+same compiled programs over the same data.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+# -- harness (parent side) ----------------------------------------------------
+
+def child_argv(**kw):
+    """argv for one child trainer invocation."""
+    argv = [sys.executable, os.path.join(REPO, "tests", "faultinject.py")]
+    for k, v in kw.items():
+        if v is None or v is False:
+            continue
+        flag = "--" + k.replace("_", "-")
+        if v is True:
+            argv.append(flag)
+        else:
+            argv.extend([flag, str(v)])
+    return argv
+
+
+def _spawn(argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)          # the child needs no virtual mesh
+    return subprocess.Popen(argv, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def run_child(timeout=240, **kw):
+    """Run the child trainer to completion; returns (returncode, stdout)."""
+    proc = _spawn(child_argv(**kw))
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError(f"faultinject child timed out:\n{out}")
+    return proc.returncode, out
+
+
+def _wait_for_valid_checkpoint(ck_dir, timeout=30.0):
+    """Poll until ``ck_dir`` holds at least one PUBLISHED checkpoint —
+    the async writer publishes a few ms after the save trigger, but a
+    loaded CI box can reorder the parent's signal ahead of it; a kill
+    delivered before ANY publish just tests a fresh start, not
+    recovery.  Filesystem-only on purpose (importing jax here would
+    stall the parent for seconds and let the child finish first): a
+    manifest part is atomically renamed into place as the commit point,
+    so its presence next to its shard file means published."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            for step in os.listdir(ck_dir):
+                sdir = os.path.join(ck_dir, step)
+                if not step.startswith("step_") or not os.path.isdir(sdir):
+                    continue
+                names = os.listdir(sdir)
+                if any(n.startswith("manifest_") and n.endswith(".json")
+                       for n in names) \
+                        and any(n.startswith("shard_")
+                                and n.endswith(".npz") for n in names):
+                    return
+        except OSError:
+            pass
+        time.sleep(0.01)
+    raise AssertionError(
+        f"no valid checkpoint appeared under {ck_dir} in {timeout}s")
+
+
+def run_and_kill(sig, kill_after_step, timeout=240, **kw):
+    """Run the child, deliver ``sig`` once a ``step N`` progress line
+    reaches ``kill_after_step`` AND one valid checkpoint exists (so the
+    kill exercises recovery, not a fresh start), and wait for exit.
+    Returns ``(returncode, stdout_so_far)`` — for SIGTERM the child
+    drains (rc 0, ``DRAINED`` marker); for SIGKILL it just dies
+    (rc -9), possibly mid-checkpoint-write."""
+    proc = _spawn(child_argv(**kw))
+    lines = []
+    sent = False
+    t0 = time.time()
+    try:
+        for line in proc.stdout:
+            lines.append(line)
+            if time.time() - t0 > timeout:
+                raise AssertionError(
+                    "faultinject child outran the kill timeout:\n"
+                    + "".join(lines))
+            if not sent and line.startswith("step "):
+                try:
+                    step = int(line.split()[1])
+                except (IndexError, ValueError):
+                    continue
+                if step >= kill_after_step:
+                    _wait_for_valid_checkpoint(kw["dir"])
+                    proc.send_signal(sig)
+                    sent = True
+                    if sig == signal.SIGKILL:
+                        break
+        proc.wait(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert sent, ("child finished before the kill step "
+                  f"{kill_after_step}:\n" + "".join(lines))
+    return proc.returncode, "".join(lines)
+
+
+# -- child trainer (subprocess side) ------------------------------------------
+
+def _child_main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", required=True)
+    p.add_argument("--out", default=None)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--spc", type=int, default=2)
+    p.add_argument("--save-every", type=int, default=2)
+    p.add_argument("--keep", type=int, default=3)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--step-delay", type=float, default=0.0,
+                   help="host sleep per window so the parent's signal "
+                        "can land mid-run")
+    p.add_argument("--sync-writes", action="store_true",
+                   help="CheckpointManager(async_write=False) — the "
+                        "bench's synchronous baseline shape")
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import checkpoint, runtime, training
+    from apex_tpu.training import make_train_step
+
+    def batch_for(step: int):
+        """The step's batch as a pure function of the GLOBAL step index
+        — the whole determinism argument in one line."""
+        rs = np.random.RandomState(1000 + step)
+        return (rs.randn(8, 16).astype(np.float32),
+                rs.randn(8, 4).astype(np.float32))
+
+    params = {"w1": jnp.ones((16, 32), jnp.float32) * 0.05,
+              "b1": jnp.zeros((32,), jnp.float32),
+              "w2": jnp.ones((32, 4), jnp.float32) * 0.1}
+
+    def loss_fn(prm, batch):
+        x, y = batch
+        h = jnp.tanh(x @ prm["w1"] + prm["b1"])
+        return jnp.mean((h @ prm["w2"] - y) ** 2)
+
+    init_fn, step_fn = make_train_step(
+        loss_fn, training.adam(lr=1e-2), opt_level="O2",
+        loss_scale="dynamic", keep_batchnorm_fp32=False)
+    state = init_fn(params)
+
+    k = max(1, args.spc)
+    pipe = runtime.StepPipeline(step_fn, k)
+    mgr = checkpoint.CheckpointManager(
+        args.dir, every_steps=args.save_every, keep=args.keep,
+        async_write=not args.sync_writes)
+    start = 0
+    if args.resume:
+        restored = mgr.restore(like=state)
+        if restored is not None:
+            state = restored.state
+            start = restored.step
+            print(f"RESUMED {start}", flush=True)
+    stop = runtime.GracefulShutdown().install()
+
+    done = start
+    drained = False
+    while done < args.steps:
+        if stop.draining:
+            mgr.save(done, state, block=True)
+            print(f"DRAINED {done}", flush=True)
+            drained = True
+            break
+        n = min(k, args.steps - done)
+        bs = [batch_for(done + j) for j in range(n)]
+        bs += [bs[-1]] * (k - n)          # ragged tail pad (n_valid gates)
+        window = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *bs)
+        state, metrics = pipe.step_window(state, window, n)
+        done += n
+        # Fence the window before reporting progress: a kill landing
+        # after this line can lose at most un-checkpointed steps, never
+        # report steps that did not happen.
+        runtime.WindowMetrics(0, n, metrics).fetch()
+        print(f"step {done}", flush=True)
+        mgr.maybe_save(done, state)
+        if args.step_delay:
+            time.sleep(args.step_delay)
+    if not drained and stop.draining:
+        mgr.save(done, state, block=True)
+        print(f"DRAINED {done}", flush=True)
+        drained = True
+    mgr.close()
+    stop.uninstall()
+    if not drained and done >= args.steps and args.out:
+        checkpoint.save_checkpoint(args.out, state, step=done)
+        print(f"FINAL {done}", flush=True)
+
+
+if __name__ == "__main__":
+    _child_main()

@@ -1,14 +1,25 @@
 """Checkpoint/resume tests — the reference's bitwise-resume gate
 (tests/L0/run_amp/test_checkpointing.py:28-300): save mid-training, restore,
-continue, and require IDENTICAL trajectories."""
+continue, and require IDENTICAL trajectories — plus the v2 elastic
+engine (ISSUE 9): async sharded CheckpointManager, manifest validation
+with newest-valid fallback, retention, per-host shard merge, device
+placement onto committed shardings, and zero1 flat-bucket resharding
+across shard counts."""
+
+import glob
+import json
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from apex_tpu import checkpoint as ckpt
 from apex_tpu import training
-from apex_tpu.checkpoint import load_checkpoint, save_checkpoint
+from apex_tpu.checkpoint import (CheckpointError, CheckpointManager,
+                                 latest_checkpoint, load_checkpoint,
+                                 load_checkpoint_dir, save_checkpoint)
 from apex_tpu.training import make_train_step
 
 
@@ -149,3 +160,294 @@ def test_amp_state_dict_roundtrip(tmp_path):
     assert any("loss_scale" in k for k in amp_sd)
     amp.load_state_dict({k: v for k, v in sd.items()})
     amp.shutdown()
+
+
+# -- satellite fixes: extras round-trip + device placement --------------------
+
+def test_extras_roundtrip_python_types(tmp_path):
+    """ISSUE 9 satellite: str/bool/None/dict extras used to crash
+    (``np.asarray(None)`` is an object array) or munge (np scalar types
+    on reload); now they round-trip with python types intact while
+    numeric scalars keep the historical array path."""
+    ck = str(tmp_path / "x.npz")
+    save_checkpoint(ck, {"w": jnp.zeros(())}, step=7, lr=0.1,
+                    run_name="imagenet-a", resumed=True, note=None,
+                    sched={"warmup": 5, "decay": "cosine"})
+    _, _, extra = load_checkpoint(ck, {"w": jnp.zeros(())})
+    assert int(extra["step"]) == 7
+    assert float(extra["lr"]) == pytest.approx(0.1)
+    assert extra["run_name"] == "imagenet-a" and isinstance(
+        extra["run_name"], str)
+    assert extra["resumed"] is True
+    assert extra["note"] is None
+    assert extra["sched"] == {"warmup": 5, "decay": "cosine"}
+
+
+def test_extras_reject_unserializable():
+    with pytest.raises(TypeError, match="not serializable|object dtype"):
+        save_checkpoint("/dev/null", {"w": jnp.zeros(())},
+                        bad=object())
+
+
+def test_load_places_leaves_on_template_sharding(tmp_path):
+    """ISSUE 9 satellite regression: restored leaves used to land as
+    host numpy regardless of the template's sharding — resuming on a
+    mesh silently un-sharded the state.  Committed template shardings
+    must be honored leaf-by-leaf."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    template = {"w": jax.device_put(jnp.arange(16.0), sh),
+                "s": jnp.float32(3.0)}          # uncommitted scalar
+    ck = str(tmp_path / "sharded.npz")
+    save_checkpoint(ck, template)
+    restored, _, _ = load_checkpoint(ck, template)
+    assert restored["w"].sharding == sh
+    assert restored["w"].committed
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(16.0))
+
+
+# -- v2: CheckpointManager ----------------------------------------------------
+
+def _state():
+    return {"w": jnp.asarray(np.arange(24.0, dtype=np.float32)),
+            "b": jnp.ones((3,), jnp.bfloat16),
+            "n": jnp.asarray(5, jnp.int32)}
+
+
+def test_manager_async_save_restore_roundtrip(tmp_path):
+    state = _state()
+    with CheckpointManager(str(tmp_path), every_steps=4) as mgr:
+        assert not mgr.maybe_save(0, state)        # cadence anchors at 0
+        assert not mgr.maybe_save(2, state)        # under the cadence
+        assert mgr.maybe_save(4, state, loader_state={"cursor": 4},
+                              note="mid")
+        assert not mgr.maybe_save(6, state)
+        mgr.wait()
+        restored = mgr.restore(like=state)
+    assert restored.step == 4
+    assert restored.loader_state == {"cursor": 4}
+    assert restored.extra["note"] == "mid"
+    assert restored.run_id
+    for k in state:
+        np.testing.assert_array_equal(
+            np.asarray(restored.state[k], np.float32),
+            np.asarray(state[k], np.float32))
+        assert restored.state[k].dtype == state[k].dtype
+
+
+def test_manager_sync_mode_and_retention(tmp_path):
+    state = _state()
+    with CheckpointManager(str(tmp_path), keep=2,
+                           async_write=False) as mgr:
+        for step in (1, 2, 3, 4):
+            mgr.save(step, state)
+    names = sorted(os.path.basename(p) for p in
+                   glob.glob(str(tmp_path / "step_*")))
+    assert names == ["step_00000003", "step_00000004"]
+
+
+def test_corrupt_newest_falls_back_to_previous_valid(tmp_path):
+    """ISSUE 9 acceptance: corrupted/truncated shard files and
+    mid-write crashes (.tmp left behind) fail cleanly to the newest
+    VALID checkpoint."""
+    state = _state()
+    with CheckpointManager(str(tmp_path), async_write=False) as mgr:
+        mgr.save(5, state)
+        mgr.save(10, state)
+    # truncate the newest shard (torn write)
+    newest = latest_checkpoint(str(tmp_path))
+    assert newest.endswith("step_00000010")
+    shard = glob.glob(os.path.join(newest, "shard_*.npz"))[0]
+    with open(shard, "r+b") as f:
+        f.truncate(16)
+    # plus .tmp debris as a mid-write crash would leave
+    with open(shard + ".tmp", "wb") as f:
+        f.write(b"partial")
+    restored = load_checkpoint_dir(str(tmp_path), state)
+    assert restored.step == 5
+
+
+def test_missing_manifest_part_is_invalid(tmp_path):
+    state = _state()
+    m0 = CheckpointManager(str(tmp_path), procs=(0, 2))
+    m1 = CheckpointManager(str(tmp_path), procs=(1, 2))
+    m0.save(3, state, block=True)
+    m1.save(3, state, block=True)
+    m0.save(6, state, block=True)       # host 1's part never lands
+    assert latest_checkpoint(str(tmp_path)).endswith("step_00000003")
+    restored = load_checkpoint_dir(str(tmp_path), state)
+    assert restored.step == 3
+    m0.close(), m1.close()
+
+
+def test_per_host_sharded_layout_merges(tmp_path):
+    """Each host writes only the leaves it owns; the reader needs every
+    part and reassembles the full tree."""
+    state = _state()
+    m0 = CheckpointManager(str(tmp_path), procs=(0, 2), run_id="r1")
+    m1 = CheckpointManager(str(tmp_path), procs=(1, 2), run_id="r1")
+    m0.save(7, state, block=True, tag="host0-extra")
+    m1.save(7, state, block=True)
+    step_dir = latest_checkpoint(str(tmp_path))
+    shards = sorted(glob.glob(os.path.join(step_dir, "shard_*.npz")))
+    assert len(shards) == 2
+    # ownership is a real split: neither shard holds the whole tree
+    with np.load(shards[0]) as a, np.load(shards[1]) as b:
+        keys_a = [k for k in a.files if not k.startswith("__")]
+        keys_b = [k for k in b.files if not k.startswith("__")]
+    assert keys_a and keys_b and not set(keys_a) & set(keys_b)
+    restored = load_checkpoint_dir(str(tmp_path), state)
+    assert restored.extra["tag"] == "host0-extra"
+    assert restored.run_id == "r1"
+    for k in state:
+        np.testing.assert_array_equal(
+            np.asarray(restored.state[k], np.float32),
+            np.asarray(state[k], np.float32))
+    m0.close(), m1.close()
+
+
+def test_manifest_checksum_catches_bit_corruption(tmp_path):
+    state = _state()
+    with CheckpointManager(str(tmp_path), async_write=False) as mgr:
+        mgr.save(1, state)
+    shard = glob.glob(str(tmp_path / "step_*" / "shard_*.npz"))[0]
+    data = bytearray(open(shard, "rb").read())
+    data[len(data) // 2] ^= 0xFF                    # flip one byte
+    open(shard, "wb").write(bytes(data))
+    assert latest_checkpoint(str(tmp_path)) is None
+    with pytest.raises(CheckpointError, match="no valid checkpoint"):
+        load_checkpoint_dir(str(tmp_path), state)
+
+
+def test_writer_error_surfaces_on_caller(tmp_path):
+    state = _state()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, state)
+    mgr.wait()
+    # break the directory out from under the writer
+    import shutil
+    shutil.rmtree(str(tmp_path / "ck"))
+    open(str(tmp_path / "ck"), "w").close()     # a FILE where a dir was
+    mgr.save(2, state)
+    with pytest.raises(CheckpointError, match="writer failed"):
+        mgr.wait()
+
+
+def test_manager_emits_checkpoint_telemetry(tmp_path):
+    from apex_tpu import telemetry
+
+    state = _state()
+    rec = telemetry.start(str(tmp_path / "run.jsonl"))
+    try:
+        with CheckpointManager(str(tmp_path / "ck")) as mgr:
+            mgr.save(3, state, block=True)
+            mgr.restore(like=state)
+    finally:
+        rec.close()
+        telemetry.set_recorder(None)
+    events = [json.loads(line) for line in
+              open(str(tmp_path / "run.jsonl")) if line.strip()]
+    phases = [e.get("phase") for e in events
+              if e.get("kind") == "checkpoint"]
+    for want in ("snapshot", "serialize", "commit", "restore"):
+        assert want in phases, phases
+    # the manager adopted the active recorder's run id
+    assert mgr.run_id == rec.run_id
+
+
+# -- elastic resharding (zero1 bucketed) --------------------------------------
+
+def test_zero1_bucketed_restores_at_different_shard_count(tmp_path):
+    """ISSUE 9 acceptance: a zero1 ``bucketed=True`` checkpoint saved at
+    shard count N restores at M != N on the CPU mesh — the manifest's
+    bucket layout lets the loader re-slice each padded flat bucket to
+    its true size and re-pad for the new world; training continues."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.multi_tensor.buckets import (BucketStore,
+                                               padded_shard_len)
+    from apex_tpu.parallel.zero import zero1, zero1_partition_spec
+    from apex_tpu.training import TrainState
+
+    shard_map = jax.shard_map
+    N, M = 4, 2
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(5, 7) * 0.3, jnp.float32),
+              "b": jnp.zeros((3,), jnp.float32)}   # 38 elems
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] + jnp.pad(p["b"], (0, 4)) - yb) ** 2)
+
+    def make(n_shards, n_dev):
+        mesh = Mesh(np.array(jax.devices("cpu")[:n_dev]), ("data",))
+        tx = zero1(training.adam(1e-2), "data", num_shards=n_shards,
+                   bucketed=True)
+        init_fn, step_fn = make_train_step(
+            loss_fn, tx, opt_level="O2", axis_name=("data",),
+            reduce_grads=False)
+        state = init_fn({k: jnp.asarray(v) for k, v in params.items()})
+        spec = TrainState(params=P(),
+                          opt_state=zero1_partition_spec(
+                              state.opt_state, "data"),
+                          scaler=P(), model_state=P())
+
+        def wrapped(s, b):
+            ns, m = step_fn(s, b)
+            return ns, jax.tree_util.tree_map(
+                lambda v: training._pmean_varying(v, ("data",)), m)
+
+        step = jax.jit(shard_map(
+            wrapped, mesh=mesh,
+            in_specs=(spec, (P("data"), P("data"))),
+            out_specs=(spec, P())))
+        return state, step
+
+    def batch(n_dev, seed):
+        r = np.random.RandomState(seed)
+        return (jnp.asarray(r.randn(4 * n_dev, 5), jnp.float32),
+                jnp.asarray(r.randn(4 * n_dev, 7) * 0.1, jnp.float32))
+
+    # train at N, checkpoint with the bucket layout
+    state_n, step_n = make(N, N)
+    for s in range(3):
+        state_n, _ = step_n(state_n, batch(N, s))
+    store = BucketStore(jax.tree_util.tree_map(
+        lambda l: jnp.asarray(l, jnp.float32), params))
+    with CheckpointManager(str(tmp_path)) as mgr:
+        mgr.save(3, state_n, block=True,
+                 bucket_layout=ckpt.bucket_layout(store, N))
+
+    # restore into the M-shard template: padded lengths differ
+    state_m, step_m = make(M, M)
+    old_len = padded_shard_len(38, N)
+    new_len = padded_shard_len(38, M)
+    assert old_len != new_len                     # 40 vs 38
+    restored = load_checkpoint_dir(str(tmp_path), state_m)
+    assert restored.step == 3
+    # params are replicated — bitwise across worlds
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(restored.state.params[k]),
+            np.asarray(state_n.params[k]))
+    # moments: the TRUE (unpadded) prefix survives the reshard exactly
+    n_inner = jax.tree_util.tree_leaves(state_n.opt_state)
+    m_inner = jax.tree_util.tree_leaves(restored.state.opt_state)
+    assert len(n_inner) == len(m_inner)
+    for a, b in zip(n_inner, m_inner):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.ndim == 1 and a.shape != b.shape:
+            assert a.shape == (old_len,) and b.shape == (new_len,)
+            np.testing.assert_array_equal(a[:38], b[:38])
+        else:
+            np.testing.assert_array_equal(a, b)
+    # and the resumed world actually trains
+    state2 = restored.state
+    for s in range(2):
+        state2, metrics = step_m(state2, batch(M, 10 + s))
+    assert np.isfinite(float(jnp.ravel(metrics["loss"])[0]))
+    assert not np.array_equal(np.asarray(state2.params["w"]),
+                              np.asarray(restored.state.params["w"]))

@@ -1,0 +1,201 @@
+"""Loader resume determinism (ISSUE 9): ``state_dict()`` → ``resume()``
+must replay the identical batch/augment stream — across multi-epoch
+reshuffles, host shards, ordered vs completion-order delivery, and the
+no-native (tier-2) install."""
+
+import os
+
+import numpy as np
+import pytest
+
+from apex_tpu.data import (BatchFiles, DirectoryImagenet, PrefetchLoader,
+                           augment_images, directory_imagenet, load_batch)
+
+
+@pytest.fixture(params=["native-default", "no-native"])
+def native_tier(request, monkeypatch):
+    if request.param == "no-native":
+        monkeypatch.setenv("APEX_TPU_DISABLE_NATIVE", "1")
+    return request.param
+
+
+def _npy_tree(tmp_path, per_class=6, classes=2, size=16):
+    rng = np.random.RandomState(7)
+    for c in range(classes):
+        d = tmp_path / f"class{c}"
+        d.mkdir()
+        for i in range(per_class):
+            np.save(d / f"s{i}.npy",
+                    rng.randint(0, 256, (size, size, 3)).astype(np.uint8))
+    return str(tmp_path)
+
+
+def _batch_key(batch):
+    """Order-independent identity of one decoded batch."""
+    imgs, labels = batch
+    return (np.asarray(imgs).tobytes(), np.asarray(labels).tobytes())
+
+
+@pytest.mark.parametrize("host_shard", [None, (0, 2), (1, 2)])
+def test_stream_resume_replays_identical_tail(tmp_path, host_shard):
+    """Mid-run (and mid-epoch) resume: a fresh stream resumed from the
+    saved state yields exactly the batches the uninterrupted stream
+    would have yielded next — across epoch boundaries (per-epoch
+    reshuffle re-derives from seed + epoch)."""
+    root = _npy_tree(tmp_path, per_class=10)   # 20 samples
+    kw = dict(batch_size=4, image_size=16, epochs=3, seed=5,
+              host_shard=host_shard)
+    full = list(directory_imagenet(root, **kw))
+    assert len(full) >= 4
+    cut = len(full) // 2 + 1          # inside epoch 1 of 3
+    consumed = directory_imagenet(root, **kw)
+    for _ in range(cut):
+        next(consumed)
+    sd = consumed.state_dict()
+    assert sd["cursor"] == cut
+    resumed = directory_imagenet(root, **kw).resume(sd)
+    tail = list(resumed)
+    assert len(tail) == len(full) - cut
+    for (a, la), (b, lb) in zip(full[cut:], tail):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_skip_equals_consume_and_seq_is_stable(tmp_path):
+    """skip(n) is pure index math but must land on the same batch AND
+    the same ``BatchFiles.seq`` as consuming n batches — seq feeds the
+    augment seed, so an off-by-one would silently change crops."""
+    root = _npy_tree(tmp_path)
+    kw = dict(batch_size=4, image_size=16, epochs=2, decode=False)
+    a = directory_imagenet(root, **kw)
+    for _ in range(3):
+        next(a)
+    b = directory_imagenet(root, **kw).skip(3)
+    ta, tb = next(a), next(b)
+    assert isinstance(ta, BatchFiles)
+    assert ta.paths == tb.paths and ta.seq == tb.seq == 3
+    np.testing.assert_array_equal(ta.labels, tb.labels)
+
+
+def test_resume_rejects_mismatched_schedule(tmp_path):
+    root = _npy_tree(tmp_path)
+    sd = directory_imagenet(root, batch_size=4, image_size=16,
+                            seed=5).state_dict()
+    other = directory_imagenet(root, batch_size=4, image_size=16, seed=6)
+    with pytest.raises(ValueError, match="resume mismatch"):
+        other.resume(sd)
+
+
+def _augment_transform(image_size):
+    """The imagenet example's deterministic augment recipe: the rng is
+    seeded from the batch's content + global seq, so identical
+    descriptors draw identical crops/flips on ANY worker, native or
+    fallback tier."""
+    import zlib
+
+    def assemble(task):
+        imgs, labels = load_batch(task)
+        rng = np.random.RandomState(
+            (zlib.crc32("|".join(task.paths).encode())
+             ^ (task.seq * 2654435761)) & 0x7FFFFFFF)
+        return augment_images(imgs, image_size - 4, rng), labels
+    return assemble
+
+
+@pytest.mark.parametrize("workers", [1, 3])
+def test_prefetch_resume_ordered_replays_identical(tmp_path, native_tier,
+                                                   workers):
+    """The full kill-and-resume input path, ordered delivery: consume
+    half through a PrefetchLoader (decode + augment in the worker
+    pool), capture ``loader.state_dict()``, rebuild stream + loader
+    from it, and require the remaining AUGMENTED stream bit-identical
+    to the uninterrupted one."""
+    root = _npy_tree(tmp_path)
+    kw = dict(batch_size=4, image_size=16, epochs=2, seed=3, decode=False)
+    assemble = _augment_transform(16)
+
+    def loader_for(stream):
+        return PrefetchLoader(stream, depth=2, workers=workers,
+                              transform=assemble, ordered=True)
+
+    with loader_for(directory_imagenet(root, **kw)) as full_loader:
+        full = list(full_loader)
+    cut = len(full) // 2 + 1
+    loader = loader_for(directory_imagenet(root, **kw))
+    it = iter(loader)
+    for _ in range(cut):
+        next(it)
+    sd = loader.state_dict()
+    loader.close()
+    assert sd["delivered"] == cut
+    # the source ran AHEAD of delivery (prefetch): the saved source
+    # state must be rewound to the delivery boundary, not the source
+    # cursor
+    assert sd["source"]["cursor"] == cut
+    resumed_stream = directory_imagenet(root, **kw).resume(sd["source"])
+    with loader_for(resumed_stream) as resumed_loader:
+        tail = list(resumed_loader)
+    assert len(tail) == len(full) - cut
+    for (a, la), (b, lb) in zip(full[cut:], tail):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_prefetch_resume_completion_order_delivers_exact_set(tmp_path,
+                                                             native_tier):
+    """Completion-order delivery trades sequence stability for latency:
+    a resumed loader still delivers EXACTLY the source batches from the
+    cursor on — as a set — and each batch's augment draws stay
+    bit-identical (seeded by content + seq, not by arrival order)."""
+    root = _npy_tree(tmp_path)
+    kw = dict(batch_size=4, image_size=16, epochs=2, seed=3, decode=False)
+    assemble = _augment_transform(16)
+    with PrefetchLoader(directory_imagenet(root, **kw), depth=2,
+                        workers=3, transform=assemble,
+                        ordered=True) as ordered_loader:
+        full = list(ordered_loader)
+    cut = len(full) // 2
+    resumed_stream = directory_imagenet(root, **kw).skip(cut)
+    with PrefetchLoader(resumed_stream, depth=2, workers=3,
+                        transform=assemble, ordered=False) as loader:
+        tail = list(loader)
+    assert len(tail) == len(full) - cut
+    want = sorted(_batch_key(b) for b in full[cut:])
+    got = sorted(_batch_key(b) for b in tail)
+    assert want == got
+
+
+def test_prefetch_state_dict_rejects_completion_order(tmp_path):
+    """Review fix: under ordered=False the delivered batches are not a
+    prefix of the source order, so no integer cursor can rewind to the
+    delivery boundary — state_dict must refuse rather than silently
+    skip in-flight batches on resume."""
+    root = _npy_tree(tmp_path)
+    loader = PrefetchLoader(
+        directory_imagenet(root, batch_size=4, image_size=16,
+                           decode=False),
+        workers=2, transform=load_batch, ordered=False)
+    with loader:
+        it = iter(loader)
+        next(it)
+        with pytest.raises(ValueError, match="ordered"):
+            loader.state_dict()
+
+
+def test_stream_survives_host_shard_cursor_math(tmp_path):
+    """Sharded resume: each host resumes its OWN cursor over the shared
+    shuffle; the interleaving of resumed shard streams reproduces the
+    unsharded tail (the property the multichip resume leans on)."""
+    root = _npy_tree(tmp_path, per_class=8)   # 16 samples, batch 2 -> 8
+    kw = dict(batch_size=2, image_size=16, seed=3, epochs=2)
+    full = list(directory_imagenet(root, **kw))
+    cut_per_host = 2
+    shards = []
+    for i in range(2):
+        s = directory_imagenet(root, host_shard=(i, 2), **kw)
+        s.skip(cut_per_host)
+        shards.append(list(s))
+    interleaved = [b for pair in zip(*shards) for b in pair]
+    for (a, la), (b, lb) in zip(full[2 * cut_per_host:], interleaved):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(la, lb)

@@ -242,3 +242,45 @@ def test_pipeline_rejects_bad_k_and_n_valid():
     window, _ = next(runtime.window_batches(iter(_batches(2)), 2))
     with pytest.raises(ValueError):
         pipe.step_window(init_fn(_params()), window, n_valid=0)
+
+
+# -- GracefulShutdown (ISSUE 9) -----------------------------------------------
+
+def test_graceful_shutdown_signal_sets_drain_flag():
+    """A real SIGTERM delivered to this process flips the drain flag
+    (the window-boundary poll the examples check) without raising; the
+    previous handler comes back on uninstall."""
+    import os as _os
+    import signal as _sig
+
+    prev = _sig.getsignal(_sig.SIGTERM)
+    with runtime.GracefulShutdown(signals=(_sig.SIGTERM,)) as stop:
+        assert not stop.draining
+        _os.kill(_os.getpid(), _sig.SIGTERM)
+        # the handler runs on the main thread at the next bytecode
+        # boundary; the event wait gives it that chance portably
+        assert stop._drain.wait(timeout=5)
+        assert stop.draining
+        assert stop.reason == "signal:SIGTERM"
+    assert _sig.getsignal(_sig.SIGTERM) is prev
+
+
+def test_graceful_shutdown_request_emits_drain_event(tmp_path):
+    import json
+
+    from apex_tpu import telemetry
+
+    rec = telemetry.start(str(tmp_path / "run.jsonl"))
+    try:
+        stop = runtime.GracefulShutdown()
+        stop.request("preemption-notice")
+        stop.request("second-call-is-idempotent")
+    finally:
+        rec.close()
+        telemetry.set_recorder(None)
+    events = [json.loads(line) for line in
+              open(str(tmp_path / "run.jsonl")) if line.strip()]
+    drains = [e for e in events if e["kind"] == "drain"]
+    assert len(drains) == 1                       # first request only
+    assert drains[0]["reason"] == "preemption-notice"
+    assert stop.draining and stop.reason == "preemption-notice"

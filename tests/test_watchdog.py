@@ -175,6 +175,73 @@ def test_retrace_storm_counts_only_true_retraces(tmp_path):
     assert alerts[0]["value"] == 3
 
 
+def test_checkpoint_stall_on_slow_snapshot(tmp_path):
+    """ISSUE 9: the async engine's contract is a cheap snapshot trigger
+    — a snapshot span over the threshold alerts; fast ones (and the
+    background serialize/commit spans, however long) stay silent."""
+    rec, wd = _recorder(tmp_path, ckpt_stall_s=0.5)
+    rec.event("checkpoint", phase="snapshot", step=10, dur=0.01,
+              bytes=100)
+    rec.event("checkpoint", phase="serialize", step=10, dur=30.0,
+              bytes=100)                       # writer thread: fine
+    rec.event("checkpoint", phase="commit", step=10, dur=30.0)
+    assert _alerts(rec) == []
+
+    rec2, wd2 = _recorder(tmp_path, ckpt_stall_s=0.5)
+    rec2.event("checkpoint", phase="snapshot", step=20, dur=1.7,
+               bytes=100)
+    alerts = _alerts(rec2)
+    assert [a["rule"] for a in alerts] == ["checkpoint_stall"]
+    assert alerts[0]["step"] == 20
+    assert "snapshot" in alerts[0]["message"]
+
+
+def test_checkpoint_stall_on_writer_backlog(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("checkpoint", phase="backlog", step=30, value=2)
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["checkpoint_stall"]
+    assert "backlog" in alerts[0]["message"]
+
+
+def test_checkpoint_failed_is_critical(tmp_path):
+    rec, wd = _recorder(tmp_path)
+    rec.event("checkpoint", phase="error", step=40,
+              error="OSError: disk full")
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["checkpoint_failed"]
+    assert alerts[0]["severity"] == "critical"
+    assert "disk full" in str(alerts[0]["value"])
+
+
+def test_checkpoint_rules_are_debounced(tmp_path):
+    """A wedged writer failing every save gets one alert per debounce
+    window, not one per failure."""
+    rec, wd = _recorder(tmp_path, debounce_steps=64)
+    for step in range(0, 200, 4):
+        rec.event("checkpoint", phase="error", step=step, error="boom")
+    alerts = [a for a in _alerts(rec) if a["rule"] == "checkpoint_failed"]
+    assert 2 <= len(alerts) <= 5
+
+
+def test_manager_snapshot_stall_reaches_watchdog(tmp_path):
+    """End to end: a real CheckpointManager save under an attached
+    watchdog with a zero threshold folds its own snapshot event into a
+    checkpoint_stall alert — the wiring, not just the rule."""
+    from apex_tpu.checkpoint import CheckpointManager
+
+    rec = telemetry.Recorder(str(tmp_path / "run.jsonl"))
+    wd = wdog.attach(rec, ckpt_stall_s=0.0)
+    telemetry.set_recorder(rec)
+    try:
+        with CheckpointManager(str(tmp_path / "ck")) as mgr:
+            mgr.save(1, {"w": jnp.ones((8,))}, block=True)
+    finally:
+        telemetry.set_recorder(None)
+    alerts = _alerts(rec)
+    assert "checkpoint_stall" in [a["rule"] for a in alerts]
+
+
 # -- debounce -----------------------------------------------------------------
 
 def test_debounce_bounds_alert_rate(tmp_path):
