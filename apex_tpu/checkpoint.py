@@ -591,6 +591,19 @@ class CheckpointManager:
         rec = self._rec()
         if rec is not None:
             rec.event("checkpoint", phase=phase, **fields)
+            # live gauges for the Prometheus exporter (ISSUE 10): a
+            # dashboard watches writer backlog and the freshest
+            # recovery point without parsing the stream.
+            if phase == "backlog":
+                rec.metrics.gauge("checkpoint_backlog").set(
+                    fields.get("value", 0))
+            elif phase == "commit":
+                rec.metrics.gauge("checkpoint_backlog").set(0)
+                if fields.get("step") is not None:
+                    rec.metrics.gauge("checkpoint_last_step").set(
+                        fields["step"])
+            elif phase == "error":
+                rec.metrics.counter("checkpoint_errors").inc()
 
     # -- cadence ------------------------------------------------------------
     @property

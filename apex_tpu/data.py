@@ -246,7 +246,12 @@ class PrefetchLoader:
         ``format_loader_line`` by construction."""
         rec = self._rec()
         if rec is not None:
-            rec.event("loader", phase=phase, stats=self.stats.as_dict())
+            stats = self.stats.as_dict()
+            rec.event("loader", phase=phase, stats=stats)
+            # live gauge for the Prometheus exporter (ISSUE 10): the
+            # same number the examples print and bench parses.
+            rec.metrics.gauge("loader_stall_pct").set(
+                stats["loader_stall_pct"])
 
     def close(self) -> None:
         """Release every pipeline this loader started: set the stop
@@ -480,6 +485,7 @@ class PrefetchLoader:
                     rec.event("loader_wait", dur=round(dt, 6),
                               qdepth=qdepth)
                     rec.metrics.histogram("loader_wait_s").observe(dt)
+                    rec.metrics.gauge("loader_queue_depth").set(qdepth)
                 yield item
         finally:
             # GeneratorExit (break / del) lands here: release the pipeline.

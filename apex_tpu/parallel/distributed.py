@@ -42,12 +42,26 @@ def _note_collective(op: str, axis_names, tree_bytes: int, n: int,
     """Report one collective's per-invocation traffic to the active
     telemetry recorder (ISSUE 5).  Runs at TRACE time — the byte counts
     are static aval properties — so the compiled program is unchanged
-    and the event appears once per compile, not once per step."""
+    and the event appears once per compile, not once per step.
+
+    ``participants`` (ISSUE 10): the product of the collective's axis
+    sizes, read at trace time, rides the event so the fleet merge can
+    model each host's wire share (``prof.fleet``'s wait-vs-wire split)
+    without re-deriving the mesh from the stream."""
     from .. import telemetry as _telemetry
     rec = _telemetry.get_recorder()
     if rec is not None and n:
+        participants = 1
+        try:
+            names = (axis_names if isinstance(axis_names, (tuple, list))
+                     else (axis_names,))
+            for a in names:
+                participants *= int(_axis_size(a))
+        except Exception:
+            participants = None
         rec.note_collective(op, axis_names, tree_bytes, n,
-                            dtype=str(dtype) if dtype is not None else None)
+                            dtype=str(dtype) if dtype is not None else None,
+                            participants=participants)
 
 
 def _axis_size(axis_name) -> int:

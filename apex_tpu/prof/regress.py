@@ -127,7 +127,14 @@ def diff_summaries(base: Dict[str, Any], cur: Dict[str, Any], *,
     higher-is-better one when ``cur < base * (1 - tol) - slack``
     (``slack`` is :data:`PCT_POINT_SLACK` for ``*pct*`` keys, else 0 —
     so integer counters like retraces/alerts fail on ANY increase from
-    zero)."""
+    zero).
+
+    The result dict is schema-versioned like ``timeline --json``
+    (ISSUE 10 satellite): CI consumes ``--json`` output and annotates
+    regressions machine-readably instead of parsing stderr, and
+    :func:`~apex_tpu.prof.timeline.check_schema_version` protects it
+    from a future tool's incompatible diff shape the same way."""
+    from .timeline import SCHEMA_VERSION
     tolerances = tolerances or {}
     fb, fc = flatten_metrics(base), flatten_metrics(cur)
     regressions: List[dict] = []
@@ -158,7 +165,8 @@ def diff_summaries(base: Dict[str, Any], cur: Dict[str, Any], *,
                 improvements.append(entry)
             else:
                 unchanged += 1
-    return {"regressions": regressions, "improvements": improvements,
+    return {"schema_version": SCHEMA_VERSION,
+            "regressions": regressions, "improvements": improvements,
             "unchanged": unchanged, "skipped": skipped}
 
 

@@ -236,7 +236,8 @@ def mfu_ledger(harvest: CostHarvest, *, step_time_s: Optional[float] = None,
                timeline: Optional[Dict[str, Any]] = None,
                peaks: Optional[Dict[str, Any]] = None,
                best_window_step_s: Optional[float] = None,
-               top: Optional[int] = None) -> Dict[str, Any]:
+               top: Optional[int] = None,
+               memory=None) -> Dict[str, Any]:
     """Join one :class:`CostHarvest` with measured time into the
     per-region MFU ledger.
 
@@ -244,6 +245,13 @@ def mfu_ledger(harvest: CostHarvest, *, step_time_s: Optional[float] = None,
     ``timeline`` (an :func:`apex_tpu.prof.timeline.analyze` result) it
     defaults to the stream's ``elapsed / steps``.  ``peaks`` is a
     :func:`load_peaks`-shaped dict (defaults to loading one).
+
+    ``memory`` (ISSUE 10) is a
+    :class:`apex_tpu.prof.memory.MemoryHarvest` of the SAME step: the
+    ledger gains a ``memory`` section (peak-HBM totals + top
+    allocations) and each region row a ``peak_hbm_mb`` column from the
+    walk's live-set-at-peak attribution — FLOPs, wire bytes, and HBM
+    residency finally read off one table.
 
     Each region row models its roofline time as
     ``max(flops/peak_flops, bytes/peak_bw)`` and is classified
@@ -264,6 +272,12 @@ def mfu_ledger(harvest: CostHarvest, *, step_time_s: Optional[float] = None,
         if steps and elapsed:
             step_time_s = elapsed / steps
 
+    mem_by_region: Dict[str, float] = {}
+    if memory is not None:
+        mem_by_region = dict(getattr(memory, "by_region", None)
+                             or (memory.get("by_region", {})
+                                 if isinstance(memory, dict) else {}))
+
     regions: List[Dict[str, Any]] = []
     modeled_total = 0.0
     for name, row in harvest.by_region.items():
@@ -271,7 +285,7 @@ def mfu_ledger(harvest: CostHarvest, *, step_time_s: Optional[float] = None,
         t_memory = row["bytes"] / peak_bw if row["bytes"] else 0.0
         modeled = max(t_compute, t_memory)
         modeled_total += modeled
-        regions.append({
+        entry = {
             "region": name,
             "flops_g": round(row["flops"] / 1e9, 6),
             "matmul_flops_g": round(row["matmul_flops"] / 1e9, 6),
@@ -281,7 +295,11 @@ def mfu_ledger(harvest: CostHarvest, *, step_time_s: Optional[float] = None,
                           if row["bytes"] else None),
             "bound": ("compute" if t_compute >= t_memory else "memory"),
             "_modeled_s": modeled,
-        })
+        }
+        if name in mem_by_region:
+            # this region's buffers live at the walk's peak-HBM moment
+            entry["peak_hbm_mb"] = round(mem_by_region[name] / 1e6, 3)
+        regions.append(entry)
     # Normalize the roofline time model onto the measured clock: the
     # scale factor is also a diagnostic — how far the real schedule sits
     # from the no-overlap roofline ideal (> 1: slower than ideal).
@@ -330,6 +348,22 @@ def mfu_ledger(harvest: CostHarvest, *, step_time_s: Optional[float] = None,
         "regions": regions,
         "regions_dropped": dropped,
     }
+    if memory is not None:
+        get = (lambda k: getattr(memory, k, None)
+               if not isinstance(memory, dict) else memory.get(k))
+        peak_b = float(get("peak_bytes") or 0)
+        out["total"]["peak_hbm_gb"] = round(peak_b / 1e9, 6)
+        out["memory"] = {
+            "peak_hbm_gb": round(peak_b / 1e9, 6),
+            "source": get("source"),
+            "argument_gb": round(float(get("argument_bytes") or 0)
+                                 / 1e9, 6),
+            "output_gb": round(float(get("output_bytes") or 0) / 1e9, 6),
+            "temp_gb": round(float(get("temp_bytes") or 0) / 1e9, 6),
+            "walk_peak_gb": round(float(get("walk_peak_bytes") or 0)
+                                  / 1e9, 6),
+            "top_allocations": list(get("top_allocations") or [])[:8],
+        }
     if step_time_s:
         out["total"]["step_ms"] = round(step_time_s * 1e3, 3)
         out["total"]["achieved_tflops"] = round(
@@ -385,6 +419,12 @@ def format_ledger(ledger: Dict[str, Any]) -> str:
         head += (f" in {t['step_ms']} ms -> {t['achieved_tflops']} TFLOP/s"
                  f" ({t['mfu_pct']}% MFU vs measured peak)")
     lines.append(head)
+    mem = ledger.get("memory")
+    if mem:
+        lines.append(
+            f"peak HBM: {mem['peak_hbm_gb']} GB [{mem['source']}] "
+            f"(args {mem['argument_gb']}, outputs {mem['output_gb']}, "
+            f"temps {mem['temp_gb']}; walk {mem['walk_peak_gb']})")
     lines.append(f"region coverage: {ledger['coverage_pct']}% of total flops")
     lines.append("{:<26} {:>10} {:>10} {:>8} {:>9} {:>7}  {}".format(
         "region", "GFLOP", "GB", "ms", "TFLOP/s", "MFU%", "bound"))
@@ -433,6 +473,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--top", type=int, default=None)
     ap.add_argument("--no-xla", action="store_true",
                     help="skip XLA cost analysis (jaxpr totals only)")
+    ap.add_argument("--memory", action="store_true",
+                    help="also harvest the peak-HBM ledger "
+                         "(prof.memory) and join it as the ledger's "
+                         "memory section / peak_hbm columns")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -441,6 +485,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     fn, ex = _load_target(args.fn)()
     harvest = harvest_costs(fn, *ex, xla=not args.no_xla,
                             region_depth=args.region_depth)
+    mem = None
+    if args.memory:
+        from . import memory as memory_mod
+        mem = memory_mod.harvest_memory(fn, *ex, xla=not args.no_xla,
+                                        region_depth=args.region_depth)
     tl = None
     if args.timeline:
         from . import timeline as timeline_mod
@@ -448,7 +497,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ledger = mfu_ledger(
         harvest,
         step_time_s=(args.step_ms / 1e3 if args.step_ms else None),
-        timeline=tl, peaks=load_peaks(args.peaks), top=args.top)
+        timeline=tl, peaks=load_peaks(args.peaks), top=args.top,
+        memory=mem)
     if args.json:
         print(json.dumps(ledger, indent=1))
     else:

@@ -50,7 +50,7 @@ __all__ = ["SCHEMA_VERSION", "load_events", "analyze", "format_report",
 #: inputs whose major it does not understand (see
 #: :func:`check_schema_version`) instead of silently comparing
 #: incompatible numbers.
-SCHEMA_VERSION = "1.0"
+SCHEMA_VERSION = "1.1"      # 1.1: + memory section (ISSUE 10)
 
 
 def check_schema_version(obj: Dict[str, Any], where: str = "input") -> None:
@@ -77,7 +77,11 @@ def check_schema_version(obj: Dict[str, Any], where: str = "input") -> None:
 
 def load_events(path: str) -> List[dict]:
     """Parse a JSONL telemetry stream; torn tail lines (a run killed
-    mid-write) are skipped, not fatal."""
+    mid-write) are skipped, not fatal.  ``path`` may be a glob, and a
+    rotated set (``run.jsonl`` + ``run.jsonl.1`` … from
+    ``telemetry.start(path, max_bytes=...)``) is re-assembled in
+    segment order automatically
+    (:func:`apex_tpu.telemetry.expand_stream_paths`)."""
     from ..telemetry.events import _iter_events
     return _iter_events(path)
 
@@ -202,6 +206,19 @@ def analyze(events: List[dict]) -> Dict[str, Any]:
                                for e in retrace_ev), 4),
     }
 
+    # -- memory ledger events (ISSUE 10) -------------------------------------
+    mem_ev = [e for e in events if e.get("kind") == "memory"]
+    if mem_ev:
+        peaks = [float(e.get("peak_bytes", 0) or 0) for e in mem_ev]
+        heads = [float(e["headroom_pct"]) for e in mem_ev
+                 if e.get("headroom_pct") is not None]
+        out["memory"] = {
+            "events": len(mem_ev),
+            "peak_hbm_gb": round(max(peaks) / 1e9, 6) if peaks else None,
+            "min_headroom_pct": (round(min(heads), 2) if heads else None),
+            "source": mem_ev[-1].get("source"),
+        }
+
     # -- watchdog alerts ------------------------------------------------------
     by_rule: Dict[str, int] = {}
     for e in alert_ev:
@@ -307,6 +324,12 @@ def format_report(a: Dict[str, Any]) -> str:
                     if rt.get("compile_s") else "")
                  + (f"  signatures: {rt['by_signature']}"
                     if rt.get("retraces") else ""))
+    mem = a.get("memory") or {}
+    if mem.get("peak_hbm_gb") is not None:
+        head = (f", min headroom {mem['min_headroom_pct']}%"
+                if mem.get("min_headroom_pct") is not None else "")
+        lines.append(f"peak HBM: {mem['peak_hbm_gb']} GB "
+                     f"[{mem.get('source')}]{head}")
     al = a.get("alerts") or {}
     if al.get("total"):
         rules = ", ".join(f"{k} x{v}"
@@ -331,7 +354,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m apex_tpu.prof.timeline",
         description="Analyze an apex_tpu telemetry JSONL stream.")
-    p.add_argument("stream", help="path to the run's .jsonl event stream")
+    p.add_argument("stream", help="path to the run's .jsonl event stream "
+                                  "(a glob or any member of a rotated "
+                                  "set loads the whole set in order)")
     p.add_argument("--json", action="store_true",
                    help="emit the analysis as JSON instead of the report")
     p.add_argument("--chrome", metavar="OUT",

@@ -289,6 +289,10 @@ class StepPipeline:
         # -> compiled, installed by warmup(); step_window dispatches to
         # them directly, bypassing jit tracing entirely.
         self._aot: dict = {}
+        # (state, window) ShapeDtypeStruct templates captured at the
+        # first dispatch — memory_stats()'s relower fallback when no
+        # AOT executable holds the compiled program (ISSUE 10).
+        self._mem_template = None
 
     def warmup(self, state, window, *, tail: bool = False):
         """AOT-compile the device loop for this ``(state, window)``
@@ -345,6 +349,12 @@ class StepPipeline:
             aot = self._aot.get(key)
             if aot is not None:
                 loop = _AotLoop(self, key, aot, loop)
+        if self._mem_template is None:
+            sds = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
+                if hasattr(l, "shape") and hasattr(l, "dtype") else l,
+                (state, window))
+            self._mem_template = sds
         step0 = self._steps_done
         self._steps_done += n
         rec = (self._telemetry if self._telemetry is not None
@@ -367,7 +377,60 @@ class StepPipeline:
         rec.metrics.histogram("window_dispatch_s").observe(t1 - t0)
         rec.metrics.histogram("window_gap_s").observe(gap)
         rec.metrics.counter("steps_dispatched").inc(n)
+        # live steps/s gauge for the Prometheus exporter (ISSUE 10):
+        # host-clock arithmetic on numbers already in hand — the rate
+        # the host actually sustained across the last dispatch cycle.
+        rec.metrics.gauge("steps_per_s").set(
+            n / max(t1 - t0 + gap, 1e-9))
         return out
+
+    def memory_stats(self, *, emit: bool = True) -> Optional[dict]:
+        """Peak-HBM ledger of the compiled hot loop (ISSUE 10): the
+        byte dict of :func:`apex_tpu.prof.memory.stats_from_analysis`
+        (argument/output/temp/generated/peak), or None when nothing was
+        dispatched yet or the jax in use exposes no
+        ``memory_analysis``.
+
+        Cost model: a :meth:`warmup`-ed pipeline already HOLDS the
+        compiled executable, so this is a pure host read; without AOT
+        the hot program is re-lowered from the first dispatch's
+        shape templates (seconds of host work at exit time — with
+        :func:`apex_tpu.cache.enable` the backend compile is a disk
+        hit).  ``emit=True`` also records the ``memory`` event +
+        ``peak_hbm_bytes`` gauge on the active recorder, which is what
+        the examples' exit ``health:`` line and the ``memory_headroom``
+        watchdog rule read."""
+        from .prof import memory as _memory
+
+        stats = None
+        for (program, _sig), compiled in self._aot.items():
+            if program != "hot":
+                continue
+            try:
+                stats = _memory.stats_from_analysis(
+                    compiled.memory_analysis())  # jaxlint: disable=J010 -- exit-time host read of an ALREADY-compiled AOT executable (no retrace/recompile); the loop stops at the first usable result
+            except Exception:
+                stats = None
+            if stats:
+                break
+        if stats is None and self._mem_template is not None:
+            state_sds, window_sds = self._mem_template
+            try:
+                compiled = self.loop.lower(
+                    state_sds, window_sds, self._full_valid).compile()
+                stats = _memory.stats_from_analysis(
+                    compiled.memory_analysis())
+            except Exception:
+                stats = None
+        if stats is None:
+            return None
+        stats["source"] = "memory_analysis"
+        if emit:
+            rec = (self._telemetry if self._telemetry is not None
+                   else _telemetry.get_recorder())
+            if rec is not None:
+                _memory.record_memory(rec, stats)
+        return stats
 
     def _note_retrace(self, rec, loop, program: str, window,
                       step0: int, dur: float = 0.0) -> None:

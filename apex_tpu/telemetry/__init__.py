@@ -28,11 +28,14 @@ See ``docs/telemetry.md`` for the event schema and overhead model.
 """
 
 from .events import (Recorder, get_recorder, set_recorder,  # noqa: F401
-                     start, to_chrome_trace)
+                     start, start_from_env, to_chrome_trace,
+                     expand_stream_paths)
+from .export import PrometheusExporter, attach_exporter     # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,            # noqa: F401
                       MetricsRegistry, Rolling)
 from .watchdog import Watchdog                              # noqa: F401
 
 __all__ = ["Recorder", "get_recorder", "set_recorder", "start",
-           "to_chrome_trace", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "Rolling", "Watchdog"]
+           "start_from_env", "to_chrome_trace", "expand_stream_paths",
+           "PrometheusExporter", "attach_exporter", "Counter", "Gauge",
+           "Histogram", "MetricsRegistry", "Rolling", "Watchdog"]

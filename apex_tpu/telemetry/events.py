@@ -45,7 +45,10 @@ or scoped: ``with telemetry.start(path): ...``.
 from __future__ import annotations
 
 import contextlib
+import glob as _glob
 import json
+import os
+import re
 import threading
 import time
 from typing import Any, Dict, IO, List, Optional, Union
@@ -53,7 +56,7 @@ from typing import Any, Dict, IO, List, Optional, Union
 from .metrics import MetricsRegistry
 
 __all__ = ["Recorder", "get_recorder", "set_recorder", "start",
-           "to_chrome_trace"]
+           "start_from_env", "to_chrome_trace", "expand_stream_paths"]
 
 _active: Optional["Recorder"] = None
 _active_lock = threading.Lock()
@@ -74,10 +77,34 @@ def set_recorder(rec: Optional["Recorder"]) -> Optional["Recorder"]:
     return prev
 
 
-def start(path: str, watchdog: bool = False,
-          run_id: Optional[str] = None, **meta) -> "Recorder":
+def _env_flag(name: str) -> Optional[bool]:
+    """Tri-state env-var read: unset -> None, else the usual truthy set."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return None
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def start(path: Optional[str] = None, watchdog: Optional[bool] = None,
+          run_id: Optional[str] = None, *,
+          max_bytes: Optional[int] = None,
+          export_textfile: Optional[str] = None,
+          export_port: Optional[int] = None,
+          export_every_s: float = 5.0,
+          process_index: Optional[int] = None,
+          process_count: Optional[int] = None,
+          **meta) -> "Recorder":
     """Open a recorder on ``path`` and install it as the active one.
     Keyword args land in the stream's leading ``run`` event.
+
+    ``path=None`` reads ``APEX_TPU_TELEMETRY`` — any entrypoint (the
+    docker matrix, ``bench.py``, a user script) can be instrumented by
+    exporting the env var instead of plumbing a flag (ISSUE 10
+    satellite); with neither a ``ValueError`` says so.  ``watchdog``
+    likewise defaults from ``APEX_TPU_WATCHDOG`` (``0``/``1``), and the
+    export knobs from ``APEX_TPU_METRICS_TEXTFILE`` /
+    ``APEX_TPU_METRICS_PORT``.  See :func:`start_from_env` for the
+    quiet does-nothing-when-unconfigured variant.
 
     ``watchdog=True`` also attaches the run-health rule engine
     (:mod:`apex_tpu.telemetry.watchdog`): events are folded online on
@@ -89,13 +116,60 @@ def start(path: str, watchdog: bool = False,
     process passes the id restored from its checkpoint so the resumed
     stream is attributable to the same logical run; omitted, a fresh id
     is generated.  Either way it rides the ``run`` event and
-    ``rec.run_id``."""
-    rec = Recorder(path, meta=meta or None, run_id=run_id)
+    ``rec.run_id``.
+
+    ``max_bytes`` bounds each stream segment (ISSUE 10 satellite): when
+    the file crosses it, the recorder writes a ``rotate`` event,
+    atomically renames the segment to ``path.<seq>`` and reopens
+    ``path`` — a week-long fleet run never grows one unbounded file.
+    ``prof.timeline`` / ``prof.fleet`` re-assemble the rotated set
+    (:func:`expand_stream_paths`).
+
+    ``export_textfile`` / ``export_port`` attach the live Prometheus
+    exporter (:mod:`apex_tpu.telemetry.export`): registry
+    counters/gauges/histograms plus watchdog health rendered to
+    text-exposition format every ``export_every_s`` seconds on the
+    threads that already emit events (zero extra host syncs) and/or
+    served from a stdlib http endpoint."""
+    if path is None:
+        path = os.environ.get("APEX_TPU_TELEMETRY") or None
+        if path is None:
+            raise ValueError(
+                "telemetry.start() needs a stream path: pass one, or set "
+                "APEX_TPU_TELEMETRY=path (use telemetry.start_from_env() "
+                "for an entrypoint that should quietly skip telemetry "
+                "when unconfigured)")
+    if watchdog is None:
+        watchdog = bool(_env_flag("APEX_TPU_WATCHDOG"))
+    if export_textfile is None:
+        export_textfile = os.environ.get("APEX_TPU_METRICS_TEXTFILE") or None
+    if export_port is None:
+        raw_port = os.environ.get("APEX_TPU_METRICS_PORT")
+        export_port = int(raw_port) if raw_port else None
+    rec = Recorder(path, meta=meta or None, run_id=run_id,
+                   max_bytes=max_bytes, process_index=process_index,
+                   process_count=process_count)
     if watchdog:
         from .watchdog import attach
         attach(rec)
+    if export_textfile is not None or export_port is not None:
+        from .export import attach_exporter
+        attach_exporter(rec, textfile=export_textfile, port=export_port,
+                        every_s=export_every_s)
     set_recorder(rec)
     return rec
+
+
+def start_from_env(**meta) -> Optional["Recorder"]:
+    """:func:`start` driven purely by env vars — returns the installed
+    :class:`Recorder` when ``APEX_TPU_TELEMETRY`` names a stream path,
+    else ``None`` without side effects.  The hook entrypoints call when
+    they have no telemetry flags of their own (``bench.py``, the docker
+    matrix): ``APEX_TPU_TELEMETRY=/tmp/run.jsonl APEX_TPU_WATCHDOG=1
+    python bench.py`` instruments the whole run."""
+    if not (os.environ.get("APEX_TPU_TELEMETRY") or "").strip():
+        return None
+    return start(**meta)
 
 
 def _json_default(x):
@@ -114,6 +188,21 @@ def _json_default(x):
     return repr(x)
 
 
+def _process_identity() -> tuple:
+    """``(process_index, process_count)`` of this host in the fleet —
+    from jax when it is already imported (never imports it: telemetry
+    must stay usable on a stream-analysis box with no jax), else
+    ``(0, 1)``."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index()), int(jax.process_count())  # jaxlint: disable=J001 -- process identity is a host-side distributed-setup constant, not a device value
+        except Exception:
+            pass
+    return 0, 1
+
+
 class Recorder:
     """Thread-safe JSONL event sink + metrics registry for one run.
 
@@ -130,7 +219,10 @@ class Recorder:
 
     def __init__(self, path_or_file: Union[str, IO], *,
                  meta: Optional[dict] = None, reservoir: int = 512,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 max_bytes: Optional[int] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
         import uuid
         #: stable identifier of the LOGICAL run — survives kill/resume
         #: when the resuming process passes the checkpointed id back
@@ -143,6 +235,23 @@ class Recorder:
             self._f = open(path_or_file, "w", encoding="utf-8")
             self._owns, self.path = True, path_or_file
         self._t0 = time.perf_counter()
+        #: run-start wall-clock anchor (unix seconds at ``t == 0``) — the
+        #: coarse cross-host alignment ``prof.fleet`` refines with
+        #: per-window dispatch indices (ISSUE 10).
+        self.anchor_unix = time.time()
+        if process_index is None or process_count is None:
+            process_index, process_count = _process_identity()
+        #: this host's slot in the fleet, stamped on the ``run`` event so
+        #: a merged multi-host analysis can attribute every stream.
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        # stream rotation (ISSUE 10 satellite): segment byte budget; the
+        # active file is always `path`, full segments atomically rename
+        # to `path.<seq>` after a trailing `rotate` event.
+        self._max_bytes = int(max_bytes) if max_bytes else None
+        self._bytes_written = 0
+        self._segment = 0
+        self._meta = dict(meta or {})
         self._closed = False
         self._counts: Dict[str, int] = {}
         #: host-side instruments, snapshotted into the ``summary`` event.
@@ -157,7 +266,19 @@ class Recorder:
         self._last_scale: Optional[float] = None
         #: optional run-health rule engine (attach_watchdog / watchdog.attach)
         self._watchdog = None
-        self.event("run", run_id=self.run_id, meta=meta or {})
+        #: optional live metrics exporter (export.attach_exporter)
+        self._exporter = None
+        self.event("run", **self._run_fields())
+
+    def _run_fields(self) -> Dict[str, Any]:
+        """The ``run`` event's fields — re-emitted at the head of every
+        rotated segment so each file in a rotated set is
+        self-describing (same run_id / anchor / host identity)."""
+        return {"run_id": self.run_id, "meta": self._meta,
+                "process_index": self.process_index,
+                "process_count": self.process_count,
+                "anchor_unix": round(self.anchor_unix, 6),
+                "segment": self._segment}
 
     # -- core sink ----------------------------------------------------------
     @property
@@ -180,6 +301,10 @@ class Recorder:
                 return
             self._f.write(line + "\n")
             self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._bytes_written += len(line) + 1
+            if (self._max_bytes is not None and self._owns and self.path
+                    and self._bytes_written >= self._max_bytes):
+                self._rotate_locked()
         # Watchdog fold (ISSUE 6): outside the stream lock, on THIS
         # thread — the event dict already exists, so the rules cost a
         # few dict reads and no device work.  Alerts the fold emits come
@@ -187,6 +312,37 @@ class Recorder:
         wd = self._watchdog
         if wd is not None and kind != "alert":
             wd.observe(rec)
+        # Live-export tick (ISSUE 10): same zero-extra-thread discipline
+        # — the exporter piggybacks on whichever thread wrote the event
+        # and renders only when its interval has elapsed.
+        exp = self._exporter
+        if exp is not None:
+            exp.tick()
+
+    def _rotate_locked(self) -> None:
+        """Seal the current segment and reopen ``path`` (stream-lock
+        held): append a ``rotate`` event, flush, atomically rename to
+        ``path.<seq>``, then start the fresh segment with a
+        continuation ``run`` event so every file in the rotated set is
+        independently attributable.  The stream clock (``t``) runs on
+        unbroken through rotations — concatenating segments in sequence
+        order reproduces the unrotated stream exactly."""
+        self._segment += 1
+        target = f"{self.path}.{self._segment}"
+        rot = {"t": round(self.now(), 6), "kind": "rotate",
+               "seq": self._segment, "to": os.path.basename(target)}
+        self._f.write(json.dumps(rot) + "\n")
+        self._counts["rotate"] = self._counts.get("rotate", 0) + 1
+        self._f.flush()
+        self._f.close()
+        os.replace(self.path, target)
+        self._f = open(self.path, "w", encoding="utf-8")
+        head = {"t": round(self.now(), 6), "kind": "run"}
+        head.update(self._run_fields())
+        line = json.dumps(head, default=_json_default)
+        self._f.write(line + "\n")
+        self._counts["run"] = self._counts.get("run", 0) + 1
+        self._bytes_written = len(line) + 1
 
     def attach_watchdog(self, watchdog) -> None:
         """Install a run-health watchdog
@@ -199,6 +355,19 @@ class Recorder:
     def watchdog(self):
         """The attached watchdog, or None."""
         return self._watchdog
+
+    def attach_exporter(self, exporter) -> None:
+        """Install a live metrics exporter
+        (:class:`apex_tpu.telemetry.export.PrometheusExporter`): its
+        ``tick()`` runs after every written event on the emitting
+        thread, and ``close()`` finalizes it (last render + endpoint
+        shutdown)."""
+        self._exporter = exporter
+
+    @property
+    def exporter(self):
+        """The attached exporter, or None."""
+        return self._exporter
 
     @contextlib.contextmanager
     def span(self, kind: str, **fields):
@@ -273,17 +442,22 @@ class Recorder:
         self._scale_hwm = step + n_valid
 
     def note_collective(self, op: str, axis, nbytes: int, n: int,
-                        dtype: Optional[str] = None) -> None:
+                        dtype: Optional[str] = None,
+                        participants: Optional[int] = None) -> None:
         """Record one collective's per-invocation traffic.  Called at
         TRACE time from ``parallel.reduce_gradients`` / ``zero1`` — the
         byte counts are static aval properties, so instrumentation costs
-        nothing at run time and the event appears once per compile."""
+        nothing at run time and the event appears once per compile.
+        ``participants`` is the collective's axis-size product (fleet
+        wait-vs-wire modelling, ISSUE 10)."""
         fields = {"op": op,
                   "axis": (list(axis) if isinstance(axis, (tuple, list))
                            else axis),
                   "bytes": int(nbytes), "n": int(n)}
         if dtype is not None:
             fields["dtype"] = dtype
+        if participants is not None:
+            fields["participants"] = int(participants)
         self.event("collective", **fields)
 
     # -- lifecycle ----------------------------------------------------------
@@ -299,6 +473,13 @@ class Recorder:
         if self._watchdog is not None:
             summary_fields["health"] = self._watchdog.health()
         self.event("summary", events=dict(self._counts), **summary_fields)
+        if self._exporter is not None:
+            # final render BEFORE the stream closes: the scrape target
+            # sees the run's last numbers (and the endpoint goes away).
+            try:
+                self._exporter.close()
+            except Exception:
+                pass
         with self._lock:
             self._closed = True
             try:
@@ -332,39 +513,92 @@ _CHROME_INSTANT_ROW = {6: "loss scale", 7: "retrace", 8: "collectives",
                        9: "markers"}
 
 
+#: rotated-segment suffix: ``run.jsonl.3`` is segment 3 of ``run.jsonl``
+_SEGMENT_RE = re.compile(r"^(?P<base>.+)\.(?P<seq>\d+)$")
+
+
+def expand_stream_paths(path_or_glob: str) -> List[str]:
+    """Resolve one stream argument — a path, a glob, or a member of a
+    rotated set — into the ordered list of segment files to read.
+
+    For each distinct stream base, rotated segments (``base.1``,
+    ``base.2``, …) come first in sequence order, then the live ``base``
+    file — the order :meth:`Recorder._rotate_locked` sealed them in, so
+    concatenation reproduces the unrotated stream.  A glob that matches
+    nothing returns the input unchanged (the open error stays the
+    caller's, with the user's own spelling)."""
+    matches = (sorted(_glob.glob(path_or_glob))
+               if _glob.has_magic(path_or_glob) else [path_or_glob])
+    if not matches:
+        return [path_or_glob]
+    bases: Dict[str, List[tuple]] = {}
+    for p in matches:
+        m = _SEGMENT_RE.match(p)
+        if m and (m.group("base") in matches
+                  or os.path.exists(m.group("base"))
+                  or _glob.glob(m.group("base") + ".*")):
+            bases.setdefault(m.group("base"), []).append(
+                (int(m.group("seq")), p))
+        else:
+            bases.setdefault(p, [])
+    out: List[str] = []
+    for base in sorted(bases):
+        segs = {p for _, p in bases[base]}
+        # pick up rotated siblings the glob itself did not name
+        for p in _glob.glob(_glob.escape(base) + ".*"):
+            m = _SEGMENT_RE.match(p)
+            if m and p not in segs:
+                bases[base].append((int(m.group("seq")), p))
+                segs.add(p)
+        out.extend(p for _, p in sorted(bases[base]))
+        if os.path.exists(base) or not bases[base]:
+            out.append(base)
+    return out
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue            # a torn tail line must not kill analysis
+    return out
+
+
 def _iter_events(events_or_path) -> List[dict]:
     if isinstance(events_or_path, str):
-        out = []
-        with open(events_or_path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue        # a torn tail line must not kill analysis
+        out: List[dict] = []
+        for p in expand_stream_paths(events_or_path):
+            out.extend(_read_jsonl(p))
         return out
     return list(events_or_path)
 
 
-def to_chrome_trace(events_or_path, out_path: str) -> int:
-    """Convert a telemetry stream (path or loaded event list) into a
-    Chrome ``trace_event`` JSON file (load in Perfetto /
-    ``chrome://tracing``).  Durational events become complete ("X")
-    slices on per-subsystem rows; scale/retrace/collective/marker events
-    become instants.  Returns the number of trace events written."""
-    events = _iter_events(events_or_path)
+def chrome_events(events, *, pid: int = 0, host: Optional[str] = None,
+                  t_offset_s: float = 0.0) -> List[dict]:
+    """One stream's Chrome ``trace_event`` dicts on process lane ``pid``
+    (metadata rows + slices/instants).  ``host`` names the lane
+    (``process_name`` metadata — ``prof.fleet`` passes ``host<i>`` so a
+    merged trace opens as a fleet timeline); ``t_offset_s`` shifts the
+    stream onto a common clock (the fleet merge's aligned offset)."""
     out: List[dict] = []
+    if host is not None:
+        out.append({"ph": "M", "pid": pid, "tid": 0,
+                    "name": "process_name", "args": {"name": host}})
     for tid, name in sorted(
             list(_CHROME_TIDS.values())
             + [(t, n) for t, n in _CHROME_INSTANT_ROW.items()]):
-        out.append({"ph": "M", "pid": 0, "tid": tid,
+        out.append({"ph": "M", "pid": pid, "tid": tid,
                     "name": "thread_name", "args": {"name": name}})
-    n = 0
+    off_us = float(t_offset_s) * 1e6
     for e in events:
         kind = e.get("kind")
-        t_us = float(e.get("t", 0.0)) * 1e6
+        t_us = float(e.get("t", 0.0)) * 1e6 + off_us
         if kind in _CHROME_TIDS:
             tid = _CHROME_TIDS[kind][0]
             dur_us = float(e.get("dur", 0.0)) * 1e6
@@ -375,17 +609,29 @@ def to_chrome_trace(events_or_path, out_path: str) -> int:
                 name = f"window@{e.get('step')}"
             elif kind == "metrics":
                 name = f"fetch@{e.get('step')}"
-            out.append({"ph": "X", "pid": 0, "tid": tid, "name": name,
+            out.append({"ph": "X", "pid": pid, "tid": tid, "name": name,
                         "ts": t_us - dur_us, "dur": max(dur_us, 1.0),
                         "args": args})
-            n += 1
         elif kind in _CHROME_INSTANT:
             args = {k: v for k, v in e.items() if k not in ("t", "kind")}
             name = kind if kind != "scale" else \
                 f"scale:{e.get('event')}@{e.get('step')}"
-            out.append({"ph": "i", "pid": 0, "tid": _CHROME_INSTANT[kind],
+            out.append({"ph": "i", "pid": pid, "tid": _CHROME_INSTANT[kind],
                         "name": name, "ts": t_us, "s": "t", "args": args})
-            n += 1
+    return out
+
+
+def to_chrome_trace(events_or_path, out_path: str) -> int:
+    """Convert a telemetry stream (path or loaded event list) into a
+    Chrome ``trace_event`` JSON file (load in Perfetto /
+    ``chrome://tracing``).  Durational events become complete ("X")
+    slices on per-subsystem rows; scale/retrace/collective/marker events
+    become instants.  Returns the number of trace events written.  For
+    a merged multi-host trace (one ``pid`` lane per host) see
+    ``python -m apex_tpu.prof.fleet --chrome``."""
+    events = _iter_events(events_or_path)
+    out = chrome_events(events)
+    n = sum(1 for e in out if e["ph"] != "M")
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump({"traceEvents": out,
                    "displayTimeUnit": "ms"}, f)

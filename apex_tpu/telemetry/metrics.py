@@ -73,15 +73,34 @@ class Counter:
 
 
 class Gauge:
-    """Last-value-wins instrument (current loss scale, queue depth)."""
+    """Last-value-wins instrument (current loss scale, queue depth).
 
-    __slots__ = ("_v",)
+    :meth:`set_max` is the high-water-mark variant the HBM gauges use
+    (ISSUE 10): a live ``bytes_in_use`` poll naturally dips, but a
+    *peak* gauge must never regress — ``peak_hbm_bytes`` keeps the
+    highest harvest the run ever recorded, across pipelines and
+    re-harvests alike."""
+
+    __slots__ = ("_v", "_lock")
 
     def __init__(self):
         self._v: Optional[float] = None
+        self._lock = threading.Lock()
 
     def set(self, v) -> None:
+        # plain set is last-value-wins by contract: a single float
+        # assignment, no lock on the hot path
         self._v = float(v)
+
+    def set_max(self, v) -> None:
+        """Monotonic set: keep ``max(current, v)``.  Locked — the
+        compare-and-set races otherwise (exporter render threads and
+        the loop thread both publish peaks) and a stale writer could
+        regress the high-water mark it promises never regresses."""
+        v = float(v)
+        with self._lock:
+            if self._v is None or v > self._v:
+                self._v = v
 
     @property
     def value(self) -> Optional[float]:
@@ -210,6 +229,9 @@ class _NoopInstrument:
         pass
 
     def set(self, v) -> None:
+        pass
+
+    def set_max(self, v) -> None:
         pass
 
     def observe(self, v) -> None:

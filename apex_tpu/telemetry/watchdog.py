@@ -46,6 +46,10 @@ Rules (each a small stateful fold; thresholds are constructor kwargs):
                           contract broke) or the writer reported a backlog
 ``checkpoint_failed``     a checkpoint write errored — the newest recovery
                           point is stale (critical)
+``memory_headroom``       a ``memory`` event (harvested peak-HBM ledger or a
+                          live device-memory read) reports free HBM below
+                          ``min_headroom_pct`` of the device limit — the
+                          pre-OOM warning, fired while the run still lives
 ========================  =====================================================
 
 Usage — the examples' ``--watchdog`` flag does exactly this::
@@ -67,7 +71,8 @@ from .metrics import Rolling
 __all__ = ["Watchdog", "attach", "RULE_NAMES"]
 
 RULE_NAMES = ("nonfinite", "scale_collapse", "loader_stall", "step_time",
-              "retrace_storm", "checkpoint_stall", "checkpoint_failed")
+              "retrace_storm", "checkpoint_stall", "checkpoint_failed",
+              "memory_headroom")
 
 
 class _Rule:
@@ -310,6 +315,46 @@ class _CheckpointFailed(_Rule):
                            f"drain now"}
 
 
+class _MemoryHeadroom(_Rule):
+    """HBM is the resource that kills runs first at scale, and it kills
+    them instantly — by the time an OOM raises there is no stream left
+    to warn from.  This rule fires from the ``memory`` events the
+    ledger emits BEFORE the water reaches the deck: a harvested
+    peak-HBM estimate (:func:`apex_tpu.prof.memory.record_memory`) or a
+    live device read whose free fraction drops under
+    ``min_headroom_pct`` of the device limit.  Events without a limit
+    (CPU backends expose no ``memory_stats``) fold to nothing — no
+    false alarms from boxes that cannot OOM this way."""
+
+    name = "memory_headroom"
+
+    def __init__(self, min_headroom_pct: float = 10.0):
+        self.min_headroom_pct = float(min_headroom_pct)
+
+    def observe(self, event):
+        if event.get("kind") != "memory":
+            return None
+        headroom = event.get("headroom_pct")
+        if headroom is None:
+            limit = float(event.get("bytes_limit", 0) or 0)
+            used = float(event.get("bytes_in_use", 0)
+                         or event.get("peak_bytes", 0) or 0)
+            if limit <= 0:
+                return None
+            headroom = 100.0 * max(0.0, 1.0 - used / limit)
+        headroom = float(headroom)
+        if headroom < self.min_headroom_pct:
+            src = event.get("source") or event.get("phase") or "memory"
+            return {"step": event.get("step"),
+                    "value": round(headroom, 2),
+                    "message": f"HBM headroom {headroom:.1f}% "
+                               f"(< {self.min_headroom_pct:.0f}%) per "
+                               f"{src} — the next growth (longer batch, "
+                               f"retrace, fragmentation) OOMs; shrink "
+                               f"the model/batch or shard further"}
+        return None
+
+
 class Watchdog:
     """Folds recorder events through the rule set and emits debounced
     ``alert`` events back into the same stream.
@@ -342,6 +387,9 @@ class Watchdog:
                 _CheckpointStall(
                     ckpt_stall_s=thresholds.get("ckpt_stall_s", 2.0)),
                 _CheckpointFailed(),
+                _MemoryHeadroom(
+                    min_headroom_pct=thresholds.get(
+                        "min_headroom_pct", 10.0)),
             ]
         self.rules = rules
         self.alerts: List[Dict[str, Any]] = []

@@ -35,7 +35,12 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # and kill-and-resume bit-parity are pure host + XLA machinery, so
 # every degradation tier must recover identically (the faultinject
 # children inherit the tier env vars through the harness).
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py -q"
+# test_fleet.py + test_export.py + test_memory.py ride for the fleet
+# observability layer (ISSUE 10): the merge/aligner and the Prometheus
+# renderer are pure host JSON/text, and the memory walk a static jaxpr
+# replay — every tier must produce identical attributions and
+# expositions.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

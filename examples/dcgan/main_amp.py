@@ -93,10 +93,25 @@ def parse():
                    help="graceful SIGTERM/SIGINT drain (ON by default): "
                         "finish the window, write a final checkpoint, "
                         "flush the recorder; second signal hard-stops")
-    p.add_argument("--telemetry", type=str, default=None, metavar="PATH",
+    p.add_argument("--telemetry", type=str, default=_os.environ.get(
+                       "APEX_TPU_TELEMETRY") or None, metavar="PATH",
                    help="record the run-telemetry event stream (JSONL) "
                    "to PATH; analyze offline with "
-                   "python -m apex_tpu.prof.timeline PATH")
+                   "python -m apex_tpu.prof.timeline PATH.  Defaults "
+                   "from APEX_TPU_TELEMETRY")
+    p.add_argument("--metrics-port", type=int, metavar="PORT",
+                   default=(int(_os.environ["APEX_TPU_METRICS_PORT"])
+                            if _os.environ.get("APEX_TPU_METRICS_PORT")
+                            else None),
+                   help="serve live Prometheus metrics on "
+                   "http://:PORT/metrics (0 = ephemeral; defaults from "
+                   "APEX_TPU_METRICS_PORT)")
+    p.add_argument("--metrics-textfile", metavar="PATH",
+                   default=_os.environ.get("APEX_TPU_METRICS_TEXTFILE")
+                   or None,
+                   help="atomically-replaced Prometheus textfile for "
+                   "node-exporter scraping (defaults from "
+                   "APEX_TPU_METRICS_TEXTFILE)")
     p.add_argument("--watchdog", action=argparse.BooleanOptionalAction,
                    default=None,
                    help="run-health rule engine over the telemetry "
@@ -362,6 +377,14 @@ def main_pipelined(opt):
     # Parsed by bench.py into loader_stall_pct: the pool is fully
     # pre-staged, so by construction the loop never waits on input.
     print("loader: stall 0.00% (pre-staged synthetic pool)")
+    # HBM memory ledger (ISSUE 10): emits the `memory` event + the
+    # peak_hbm_bytes gauge the exit health: line reads.
+    try:
+        mem = pipe.memory_stats()
+        if mem is not None:
+            print(f"memory: peak-hbm {mem['peak_bytes'] / 1e6:.1f}MB")
+    except Exception as e:                       # pragma: no cover
+        print(f"memory: ledger unavailable ({type(e).__name__}: {e})")
     print(f"done in {t1 - t0:.1f}s ({n_done / (t1 - t0):.2f} it/s)")
 
 
@@ -556,7 +579,8 @@ def main():
     rec = None
     use_watchdog = (opt.watchdog if opt.watchdog is not None
                     else bool(opt.telemetry))
-    if opt.telemetry or use_watchdog:
+    if (opt.telemetry or use_watchdog or opt.metrics_port is not None
+            or opt.metrics_textfile):
         # Active recorder installed before either mode builds its loop:
         # the pipelined path records window/gap/metrics events through
         # StepPipeline; the imperative path records the per-step
@@ -566,8 +590,12 @@ def main():
         rec = telemetry.start(
             opt.telemetry or _os.devnull, watchdog=use_watchdog,
             example="dcgan",
+            export_port=opt.metrics_port,
+            export_textfile=opt.metrics_textfile,
             mode="imperative" if opt.imperative else "pipelined",
             opt_level=opt.opt_level, steps_per_call=opt.steps_per_call)
+        if rec.exporter is not None:
+            print(f"metrics export: {rec.exporter.describe()}")
     try:
         if opt.imperative:
             main_imperative(opt)
@@ -581,7 +609,13 @@ def main():
                 print(f"telemetry: {opt.telemetry} "
                       f"(python -m apex_tpu.prof.timeline to analyze)")
             if wd is not None:
-                print(f"health: {wd.format_line()}")
+                extras = ""
+                peak = rec.metrics.gauge("peak_hbm_bytes").value
+                if peak:
+                    extras += f"  peak-hbm {peak / 1e6:.1f}MB"
+                if rec.exporter is not None:
+                    extras += f"  export {rec.exporter.describe()}"
+                print(f"health: {wd.format_line()}{extras}")
 
 
 if __name__ == "__main__":

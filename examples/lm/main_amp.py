@@ -100,10 +100,25 @@ def parse():
                    help="graceful SIGTERM/SIGINT drain (ON by default): "
                         "finish the window, write a final checkpoint, "
                         "flush the recorder; second signal hard-stops")
-    p.add_argument("--telemetry", type=str, default=None, metavar="PATH",
+    p.add_argument("--telemetry", type=str, default=_os.environ.get(
+                       "APEX_TPU_TELEMETRY") or None, metavar="PATH",
                    help="record the run-telemetry event stream (JSONL) "
                         "to PATH; analyze offline with "
-                        "python -m apex_tpu.prof.timeline PATH")
+                        "python -m apex_tpu.prof.timeline PATH.  "
+                        "Defaults from APEX_TPU_TELEMETRY")
+    p.add_argument("--metrics-port", type=int, metavar="PORT",
+                   default=(int(_os.environ["APEX_TPU_METRICS_PORT"])
+                            if _os.environ.get("APEX_TPU_METRICS_PORT")
+                            else None),
+                   help="serve live Prometheus metrics on "
+                        "http://:PORT/metrics (0 = ephemeral; defaults "
+                        "from APEX_TPU_METRICS_PORT)")
+    p.add_argument("--metrics-textfile", metavar="PATH",
+                   default=_os.environ.get("APEX_TPU_METRICS_TEXTFILE")
+                   or None,
+                   help="atomically-replaced Prometheus textfile for "
+                        "node-exporter scraping (defaults from "
+                        "APEX_TPU_METRICS_TEXTFILE)")
     p.add_argument("--watchdog", action=argparse.BooleanOptionalAction,
                    default=None,
                    help="run-health rule engine over the telemetry "
@@ -122,15 +137,20 @@ def main():
     rec = None
     use_watchdog = (args.watchdog if args.watchdog is not None
                     else bool(args.telemetry))
-    if args.telemetry or use_watchdog:
+    if (args.telemetry or use_watchdog or args.metrics_port is not None
+            or args.metrics_textfile):
         # Install the active recorder before the pipeline is built so
         # StepPipeline and the deferred metric reads pick it up.
         from apex_tpu import telemetry
         rec = telemetry.start(args.telemetry or _os.devnull,
                               watchdog=use_watchdog, example="lm",
+                              export_port=args.metrics_port,
+                              export_textfile=args.metrics_textfile,
                               opt_level=args.opt_level,
                               attention=args.attention,
                               steps_per_call=args.steps_per_call)
+        if rec.exporter is not None:
+            print(f"metrics export: {rec.exporter.describe()}")
     try:
         # close() in finally: a diverged/killed run still flushes its
         # stream, the summary event, and the watchdog's final alerts.
@@ -143,7 +163,13 @@ def main():
                 print(f"telemetry: {args.telemetry} "
                       f"(python -m apex_tpu.prof.timeline to analyze)")
             if wd is not None:
-                print(f"health: {wd.format_line()}")
+                extras = ""
+                peak = rec.metrics.gauge("peak_hbm_bytes").value
+                if peak:
+                    extras += f"  peak-hbm {peak / 1e6:.1f}MB"
+                if rec.exporter is not None:
+                    extras += f"  export {rec.exporter.describe()}"
+                print(f"health: {wd.format_line()}{extras}")
 
 
 def _train(args):
@@ -320,6 +346,15 @@ def _train(args):
     # waits on input; a real-data loader would report its PrefetchLoader
     # stats here (see examples/imagenet).
     print("loader: stall 0.00% (pre-staged synthetic window)")
+    # HBM memory ledger (ISSUE 10): one exit-time relower (disk-cached
+    # under apex_tpu.cache) feeding the `memory` event, the
+    # peak_hbm_bytes gauge, and the health: line's peak-hbm figure.
+    try:
+        mem = pipe.memory_stats()
+        if mem is not None:
+            print(f"memory: peak-hbm {mem['peak_bytes'] / 1e6:.1f}MB")
+    except Exception as e:                       # pragma: no cover
+        print(f"memory: ledger unavailable ({type(e).__name__}: {e})")
     assert np.isfinite(loss), "training diverged"
 
 

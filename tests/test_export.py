@@ -1,0 +1,332 @@
+"""Live metrics export (ISSUE 10): Prometheus rendering, the atomic
+textfile, the http endpoint, env-var startup, and stream rotation.
+
+The contracts tier-1 pins here:
+
+* **disabled-path bitwise identity** — a training loop run with the
+  exporter attached produces BITWISE-identical parameters to the
+  uninstrumented run (the exporter rides the recorder's event threads;
+  no recorder, no exporter, no difference);
+* **scrape under load** — concurrent scrapes against a loop that is
+  actively emitting events return complete, parseable exposition text
+  carrying the loop's own instruments;
+* **atomic textfile** — the scrape file is replaced via rename, so a
+  reader never observes a torn render;
+* **env-var startup** — ``APEX_TPU_TELEMETRY`` / ``APEX_TPU_WATCHDOG``
+  / ``APEX_TPU_METRICS_*`` configure :func:`telemetry.start` and
+  :func:`telemetry.start_from_env` without flags (ISSUE 10 satellite);
+* **rotation** — ``max_bytes`` seals segments with a ``rotate`` event
+  + atomic rename, every segment is self-describing, and the analyzers
+  re-assemble the set.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import runtime, telemetry, training
+from apex_tpu.prof import timeline
+from apex_tpu.telemetry import export as tel_export
+from apex_tpu.training import make_train_step
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.set_recorder(None)
+    yield
+    telemetry.set_recorder(None)
+
+
+def _loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+def _run_loop(k=4, n=8, dim=32):
+    rs = np.random.RandomState(0)
+    batches = [(rs.randn(4, dim).astype(np.float32),
+                rs.randn(4, dim).astype(np.float32)) for _ in range(n)]
+    init_fn, step_fn = make_train_step(_loss_fn, training.sgd(lr=0.01))
+    pipe = runtime.StepPipeline(step_fn, k)
+    state = init_fn({"w": jnp.asarray(rs.randn(dim, dim)
+                                      .astype(np.float32) / 11.0)})
+    state, reader = pipe.run(
+        state, runtime.window_batches(iter(batches), k))
+    reader.last()
+    # deep-copy the fetched leaves: on CPU device_get can hand back
+    # zero-copy views into device buffers, and a LATER loop's buffer
+    # reuse would corrupt the first snapshot (flaky bitwise compare)
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True),
+        jax.device_get(state.params))  # jaxlint: disable=J001 -- end-of-test host materialization
+
+
+# -- rendering ----------------------------------------------------------------
+
+def test_render_families(tmp_path):
+    rec = telemetry.start(str(tmp_path / "r.jsonl"), watchdog=True,
+                          example="t")
+    rec.metrics.counter("steps_dispatched").inc(7)
+    rec.metrics.gauge("steps_per_s").set(123.5)
+    for v in (0.1, 0.2, 0.3):
+        rec.metrics.histogram("window_dispatch_s").observe(v)
+    text = tel_export.render(rec)
+    assert "# TYPE apex_tpu_steps_dispatched_total counter" in text
+    assert "apex_tpu_steps_dispatched_total 7" in text
+    assert "apex_tpu_steps_per_s 123.5" in text
+    assert 'apex_tpu_window_dispatch_s{quantile="0.5"} 0.2' in text
+    assert "apex_tpu_window_dispatch_s_count 3" in text
+    assert "apex_tpu_watchdog_ok 1" in text
+    assert f'run_id="{rec.run_id}"' in text
+    assert 'process_index="0"' in text
+    rec.close()
+
+
+def test_render_nonfinite_values(tmp_path):
+    """A NaN/inf gauge (an overflow-skipped window's loss) renders as
+    the legal Prometheus literals instead of crashing the textfile
+    into self-disable (regression: int(NaN) raised)."""
+    rec = telemetry.start(str(tmp_path / "r.jsonl"))
+    rec.metrics.gauge("loss").set(float("nan"))
+    rec.metrics.gauge("hi").set(float("inf"))
+    rec.metrics.gauge("lo").set(float("-inf"))
+    text = tel_export.render(rec)
+    assert "apex_tpu_loss NaN" in text
+    assert "apex_tpu_hi +Inf" in text
+    assert "apex_tpu_lo -Inf" in text
+    rec.close()
+
+
+def test_render_sanitizes_names(tmp_path):
+    rec = telemetry.start(str(tmp_path / "r.jsonl"))
+    rec.metrics.gauge("weird-name.with/chars").set(1)
+    text = tel_export.render(rec)
+    assert "apex_tpu_weird_name_with_chars 1" in text
+    rec.close()
+
+
+def test_watchdog_alerts_render(tmp_path):
+    rec = telemetry.start(str(tmp_path / "r.jsonl"), watchdog=True)
+    # a memory event under the headroom floor fires the new rule
+    rec.event("memory", phase="harvest", peak_bytes=99,
+              bytes_limit=100, headroom_pct=1.0)
+    text = tel_export.render(rec)
+    assert "apex_tpu_watchdog_ok 0" in text
+    assert ('apex_tpu_watchdog_rule_alerts_total'
+            '{rule="memory_headroom"} 1') in text
+    rec.close()
+
+
+# -- textfile -----------------------------------------------------------------
+
+def test_textfile_written_and_atomic(tmp_path):
+    tf = str(tmp_path / "m.prom")
+    rec = telemetry.start(str(tmp_path / "r.jsonl"),
+                          export_textfile=tf, export_every_s=0.01)
+    rec.metrics.counter("c").inc()
+    import time
+    time.sleep(0.02)
+    rec.event("marker", op="tick")       # tick rides the event write
+    assert os.path.exists(tf)
+    assert not os.path.exists(tf + ".tmp")   # replaced, not left behind
+    body = open(tf).read()
+    assert body.endswith("\n")
+    assert "apex_tpu_c_total 1" in body
+    renders_before_close = rec.exporter.renders
+    rec.close()                           # final render on close
+    assert rec.exporter.renders == renders_before_close + 1
+
+
+def test_unwritable_textfile_disables_itself(tmp_path, capsys):
+    rec = telemetry.start(str(tmp_path / "r.jsonl"),
+                          export_textfile=str(tmp_path / "no" / "m.prom"),
+                          export_every_s=0.0)
+    import time
+    time.sleep(0.01)
+    rec.event("marker", op="tick")
+    assert rec.exporter.textfile is None      # disabled, not poisoned
+    rec.event("marker", op="tick2")           # stream keeps working
+    rec.close()
+    events = timeline.load_events(str(tmp_path / "r.jsonl"))
+    assert sum(1 for e in events if e["kind"] == "marker") == 2
+
+
+# -- http endpoint ------------------------------------------------------------
+
+def test_scrape_under_load(tmp_path):
+    """Concurrent scrapes while the training loop emits: every response
+    is complete exposition text carrying the loop's instruments."""
+    rec = telemetry.start(str(tmp_path / "r.jsonl"), watchdog=True,
+                          export_port=0)
+    url = f"http://localhost:{rec.exporter.port}/metrics"
+    bodies, errors = [], []
+
+    def scrape():
+        try:
+            for _ in range(5):
+                bodies.append(urllib.request.urlopen(url, timeout=10)
+                              .read().decode())
+        except Exception as e:            # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=scrape) for _ in range(3)]
+    for t in threads:
+        t.start()
+    _run_loop()                            # emits while scrapes fly
+    for t in threads:
+        t.join()
+    # one more scrape after the loop, while the recorder is still open:
+    # the exposition must carry the loop's own instruments by now
+    final = urllib.request.urlopen(url, timeout=10).read().decode()
+    rec.close()
+    assert not errors
+    assert len(bodies) == 15
+    for b in bodies:
+        assert "apex_tpu_run_info" in b
+        assert b.endswith("\n")
+    assert "apex_tpu_steps_dispatched_total" in final
+    assert "apex_tpu_window_dispatch_s_count" in final
+    # endpoint is gone after close
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url, timeout=2)
+
+
+def test_http_404_off_path(tmp_path):
+    rec = telemetry.start(str(tmp_path / "r.jsonl"), export_port=0)
+    url = f"http://localhost:{rec.exporter.port}/other"
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(url, timeout=10)
+    rec.close()
+
+
+# -- disabled-path identity ---------------------------------------------------
+
+def test_disabled_path_bitwise_identity(tmp_path):
+    """Exporter-on vs telemetry-off: bitwise-identical parameters."""
+    params_off = _run_loop()
+    rec = telemetry.start(str(tmp_path / "r.jsonl"), watchdog=True,
+                          export_textfile=str(tmp_path / "m.prom"),
+                          export_port=0, export_every_s=0.01)
+    params_on = _run_loop()
+    rec.close()
+    for a, b in zip(jax.tree_util.tree_leaves(params_off),
+                    jax.tree_util.tree_leaves(params_on)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the instrumented run actually exported something
+    assert os.path.exists(tmp_path / "m.prom")
+    body = open(tmp_path / "m.prom").read()
+    assert "apex_tpu_steps_per_s" in body
+    assert "apex_tpu_loader" not in body or True   # loader gauges optional
+
+
+# -- env vars (ISSUE 10 satellite) --------------------------------------------
+
+def test_start_from_env_unset_is_none(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_TELEMETRY", raising=False)
+    assert telemetry.start_from_env(example="t") is None
+    assert telemetry.get_recorder() is None
+
+
+def test_start_requires_a_path(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_TELEMETRY", raising=False)
+    with pytest.raises(ValueError, match="APEX_TPU_TELEMETRY"):
+        telemetry.start()
+
+
+def test_start_from_env_full_config(tmp_path, monkeypatch):
+    path = str(tmp_path / "envrun.jsonl")
+    tf = str(tmp_path / "env.prom")
+    monkeypatch.setenv("APEX_TPU_TELEMETRY", path)
+    monkeypatch.setenv("APEX_TPU_WATCHDOG", "1")
+    monkeypatch.setenv("APEX_TPU_METRICS_TEXTFILE", tf)
+    monkeypatch.setenv("APEX_TPU_METRICS_PORT", "0")
+    rec = telemetry.start_from_env(example="env")
+    assert rec is not None
+    assert telemetry.get_recorder() is rec
+    assert rec.watchdog is not None
+    assert rec.exporter is not None
+    assert rec.exporter.textfile == tf
+    assert rec.exporter.port not in (None, 0)    # ephemeral port bound
+    rec.close()
+    events = timeline.load_events(path)
+    assert events[0]["kind"] == "run"
+    assert events[0]["meta"]["example"] == "env"
+
+
+def test_env_watchdog_off_beats_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("APEX_TPU_WATCHDOG", "0")
+    rec = telemetry.start(str(tmp_path / "r.jsonl"))
+    assert rec.watchdog is None
+    rec.close()
+    # explicit argument beats the env var
+    monkeypatch.setenv("APEX_TPU_WATCHDOG", "0")
+    rec = telemetry.start(str(tmp_path / "r2.jsonl"), watchdog=True)
+    assert rec.watchdog is not None
+    rec.close()
+
+
+# -- rotation (ISSUE 10 satellite) --------------------------------------------
+
+def test_rotation_seals_segments(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    rec = telemetry.start(path, max_bytes=500, example="rot")
+    for i in range(60):
+        rec.event("marker", op=f"m{i}")
+    rec.close()
+    segs = sorted(p for p in os.listdir(tmp_path)
+                  if p.startswith("rot.jsonl."))
+    assert segs, "rotation never happened"
+    # every sealed segment ends with a rotate event and is bounded
+    for seg in segs:
+        lines = open(tmp_path / seg).read().splitlines()
+        last = json.loads(lines[-1])
+        assert last["kind"] == "rotate"
+        assert os.path.getsize(tmp_path / seg) < 500 + 400
+    # every segment AFTER the first opens with a self-describing run
+    # continuation (same run_id, its own segment number)
+    run0 = json.loads(open(tmp_path / segs[0]).readline())
+    for seg in segs[1:] + ["rot.jsonl"]:
+        head = json.loads(open(tmp_path / seg).readline())
+        assert head["kind"] == "run"
+        assert head["run_id"] == run0["run_id"]
+        assert head["segment"] > 0
+
+
+def test_rotated_set_reassembles(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    rec = telemetry.start(path, max_bytes=400)
+    n_markers = 50
+    for i in range(n_markers):
+        rec.event("marker", op=f"m{i}", seq=i)
+    rec.close()
+    events = timeline.load_events(path)     # base path finds the set
+    markers = [e for e in events if e["kind"] == "marker"]
+    assert len(markers) == n_markers
+    assert [m["seq"] for m in markers] == list(range(n_markers))
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)                  # one unbroken clock
+    # a glob spelling works too
+    events2 = timeline.load_events(str(tmp_path / "rot.jsonl*"))
+    assert ([e for e in events2 if e["kind"] == "marker"]
+            == markers)
+    # summary landed in the LIVE file (the last segment)
+    assert any(e["kind"] == "summary" for e in events)
+
+
+def test_rotation_never_splits_mid_line(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    rec = telemetry.start(path, max_bytes=300)
+    for i in range(40):
+        rec.event("marker", op="x" * 50, i=i)
+    rec.close()
+    for p in [path] + [str(tmp_path / s) for s in os.listdir(tmp_path)
+                       if s.startswith("rot.jsonl.")]:
+        for line in open(p):
+            json.loads(line)                # every line parses whole

@@ -1,0 +1,273 @@
+"""Fleet observability (ISSUE 10): multi-host stream merge, clock
+alignment, straggler attribution, and the per-host Chrome pid lanes.
+
+The contracts tier-1 pins here:
+
+* **merge ordering** — N per-host streams (globs, explicit paths, and
+  rotated sets) load as one fleet, attributed by the ``run`` event's
+  ``process_index`` stamp, with rotated segments re-assembled in
+  sequence order;
+* **clock alignment** — the aligner recovers a known injected
+  wall-anchor skew from the window dispatch indices alone (the anchor
+  gets the streams within coarse range; the per-step median closes it);
+* **straggler attribution** — the injected slow host is named slowest
+  on EVERY window of the synthetic 4-host fixture (the bench gate's
+  exact criterion), loader-stall asymmetry names the stalling host;
+* **fleet Chrome trace** — one ``pid`` lane per host, process_name
+  metadata per lane, events shifted onto the aligned clock.
+
+Everything here is pure host-side JSON — it rides the tier matrix
+(docker/run_matrix.sh FAST) because every degradation tier must
+analyze identical fixtures identically.
+"""
+
+import json
+import os
+
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.prof import fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.set_recorder(None)
+    yield
+    telemetry.set_recorder(None)
+
+
+SLOW = 2
+CLOCK_ERR = (0.040, -0.040, 0.080, -0.080)
+
+
+@pytest.fixture
+def fixture_dir(tmp_path):
+    fleet.synthetic_fleet(4, 12, 4, slow_host=SLOW,
+                          clock_err_s=CLOCK_ERR, dir=str(tmp_path))
+    return tmp_path
+
+
+# -- merge / load -------------------------------------------------------------
+
+def test_load_fleet_glob_and_explicit(fixture_dir):
+    via_glob = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    explicit = fleet.load_fleet(
+        [str(fixture_dir / f"host{h}.jsonl") for h in range(4)])
+    assert [s.host for s in via_glob] == [0, 1, 2, 3]
+    assert [s.host for s in explicit] == [0, 1, 2, 3]
+    for s in via_glob:
+        assert s.run_id == "fleet-fixture-0"
+        assert s.process_count == 4
+        assert s.anchor_unix is not None
+        assert len(s.windows) == 12
+
+
+def test_load_fleet_nothing_matched(tmp_path):
+    with pytest.raises(ValueError, match="no telemetry events"):
+        fleet.load_fleet([str(tmp_path / "nope*.jsonl")])
+
+
+def test_load_fleet_duplicate_process_index(tmp_path):
+    """Two streams stamped with the same index must stay two hosts —
+    folding them together would corrupt every skew number."""
+    events = fleet.synthetic_fleet(2, 4, 4, slow_host=1,
+                                   clock_err_s=(0.0, 0.0))
+    for name, evs in (("a.jsonl", events[0]), ("b.jsonl", events[0])):
+        with open(tmp_path / name, "w") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+    streams = fleet.load_fleet([str(tmp_path / "a.jsonl"),
+                                str(tmp_path / "b.jsonl")])
+    assert len({s.host for s in streams}) == 2
+
+
+def test_merge_accepts_rotated_set(tmp_path):
+    """A host whose stream rotated mid-run merges from its segments in
+    order — same windows as the unrotated stream."""
+    events = fleet.synthetic_fleet(2, 6, 4, slow_host=1,
+                                   clock_err_s=(0.0, 0.0))
+    # host0: write an artificially rotated set (segment split mid-way)
+    base = tmp_path / "host0.jsonl"
+    seg = tmp_path / "host0.jsonl.1"
+    cut = len(events[0]) // 2
+    with open(seg, "w") as f:
+        for e in events[0][:cut]:
+            f.write(json.dumps(e) + "\n")
+    with open(base, "w") as f:
+        for e in events[0][cut:]:
+            f.write(json.dumps(e) + "\n")
+    with open(tmp_path / "host1.jsonl", "w") as f:
+        for e in events[1]:
+            f.write(json.dumps(e) + "\n")
+    streams = fleet.load_fleet([str(tmp_path / "host*.jsonl")])
+    assert len(streams) == 2
+    h0 = next(s for s in streams if s.host == 0)
+    assert len(h0.windows) == 6          # both segments contributed
+    ts = [e["t"] for e in h0.events]
+    assert ts == sorted(ts)              # segment order preserved
+
+
+# -- clock alignment ----------------------------------------------------------
+
+def test_clock_alignment_recovers_injected_skew(fixture_dir):
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    align = fleet.align_clocks(streams)
+    for h in range(4):
+        expected_ms = (CLOCK_ERR[h] - CLOCK_ERR[0]) * 1e3
+        got_ms = 1e3 * align[h]["clock_skew_s"]
+        assert abs(got_ms - expected_ms) <= 5.0, (h, got_ms, expected_ms)
+        assert align[h]["anchored"]
+        assert align[h]["common_windows"] == 12
+
+
+def test_alignment_without_anchors(tmp_path):
+    """Streams that predate the anchor stamp still align (windows
+    alone), and are flagged unanchored."""
+    events = fleet.synthetic_fleet(2, 6, 4, slow_host=1,
+                                   clock_err_s=(0.0, 0.0))
+    for h, evs in enumerate(events):
+        with open(tmp_path / f"host{h}.jsonl", "w") as f:
+            for e in evs:
+                e = dict(e)
+                e.pop("anchor_unix", None)
+                f.write(json.dumps(e) + "\n")
+    streams = fleet.load_fleet([str(tmp_path / "host*.jsonl")])
+    align = fleet.align_clocks(streams)
+    assert not align[0]["anchored"] and not align[1]["anchored"]
+    a = fleet.analyze_fleet(streams)
+    assert a["straggler"]["host"] == 1
+
+
+# -- straggler attribution ----------------------------------------------------
+
+def test_straggler_identified_every_window(fixture_dir):
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    a = fleet.analyze_fleet(streams)
+    assert a["n_hosts"] == 4
+    assert len(a["windows"]) == 12
+    assert all(w["slowest_host"] == SLOW for w in a["windows"])
+    st = a["straggler"]
+    assert st["host"] == SLOW
+    assert st["windows_slowest"] == st["windows_total"] == 12
+    assert st["consistent"]
+    assert st["mean_skew_ms"] > 0
+
+
+def test_no_consistent_straggler_when_balanced(tmp_path):
+    """With no injected slow host the slowest rotates with the seeded
+    jitter — nobody should be called the consistent straggler."""
+    fleet.synthetic_fleet(4, 12, 4, slow_host=0, slow_factor=1.0,
+                          stall_host=0, clock_err_s=(0, 0, 0, 0),
+                          dir=str(tmp_path))
+    streams = fleet.load_fleet([str(tmp_path / "host*.jsonl")])
+    a = fleet.analyze_fleet(streams)
+    assert not a["straggler"]["consistent"]
+
+
+def test_loader_asymmetry_and_skew_table(fixture_dir):
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    a = fleet.analyze_fleet(streams)
+    lo = a["loader"]
+    assert lo["worst_host"] == SLOW
+    assert lo["asymmetric"]
+    assert lo["spread_pct_points"] > 10
+    hosts = {h["host"]: h for h in a["hosts"]}
+    assert hosts[SLOW]["loader_stall_pct"] == 35.0
+    # per-host rows carry per-host timeline numbers
+    assert all(h["steps"] == 48 for h in a["hosts"])
+
+
+def test_wait_vs_wire_split(fixture_dir):
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    a = fleet.analyze_fleet(streams, ici_gb_s=100.0)
+    co = a["collectives"]
+    assert co["by_op"], "fixture's psum must appear"
+    c = co["by_op"][0]
+    assert c["op"] == "psum"
+    assert c["bytes_per_step"] == 4_000_000
+    # ring all-reduce at N=4: 2(N-1)/N = 1.5x the payload per link,
+    # 4 MB * 1.5 at 100 GB/s = 0.06 ms wire
+    assert c["wire_factor"] == 1.5
+    assert abs(c["wire_ms_modeled"] - 0.06) < 1e-6
+    assert c["wait_ms_modeled"] > 0
+    assert 0 <= c["wait_pct"] <= 100
+    assert c["participants"] == 4
+
+
+def test_schema_version_rides_fleet_json(fixture_dir):
+    from apex_tpu.prof.timeline import SCHEMA_VERSION, \
+        check_schema_version
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    a = fleet.analyze_fleet(streams)
+    assert a["schema_version"] == SCHEMA_VERSION
+    check_schema_version(a, "fleet")     # round-trips its own schema
+
+
+# -- chrome export ------------------------------------------------------------
+
+def test_fleet_chrome_pid_lanes(fixture_dir, tmp_path):
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    out = str(tmp_path / "fleet_trace.json")
+    n = fleet.to_fleet_chrome_trace(streams, out)
+    assert n > 0
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert {e["pid"] for e in events} == {0, 1, 2, 3}
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {f"host {h} of 4" for h in range(4)}
+    # every lane carries real slices, on a shared non-negative clock
+    for h in range(4):
+        slices = [e for e in events if e["pid"] == h and e["ph"] == "X"
+                  and e["name"].startswith("window@")]
+        assert len(slices) == 12
+        assert all(e["ts"] >= 0 for e in slices)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_report_and_json(fixture_dir, tmp_path, capsys):
+    rc = fleet.main([str(fixture_dir / "host*.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "CONSISTENT straggler" in out
+    assert "host 2" in out
+    rc = fleet.main([str(fixture_dir / "host*.jsonl"), "--json",
+                     "--chrome", str(tmp_path / "t.json")])
+    cap = capsys.readouterr()
+    assert rc == 0
+    a = json.loads(cap.out)
+    assert a["straggler"]["host"] == SLOW
+    assert os.path.exists(tmp_path / "t.json")
+    assert "pid lanes" in cap.err
+
+
+def test_cli_no_match_exits_2(tmp_path, capsys):
+    rc = fleet.main([str(tmp_path / "none*.jsonl")])
+    assert rc == 2
+    assert "error" in capsys.readouterr().err
+
+
+# -- end-to-end: real recorders, merged ---------------------------------------
+
+def test_real_recorders_merge(tmp_path):
+    """Four REAL Recorders (explicit process stamps) round-trip through
+    the merge: host identity, window matching, per-host analysis."""
+    import time
+    for h in range(4):
+        rec = telemetry.Recorder(str(tmp_path / f"h{h}.jsonl"),
+                                 meta={"example": "t"},
+                                 run_id="merged-run",
+                                 process_index=h, process_count=4)
+        for w in range(3):
+            rec.event("window", step=w * 2, k=2, n_valid=2,
+                      dur=0.010 * (2 if h == 3 else 1), gap=0.001)
+        rec.close()
+    streams = fleet.load_fleet([str(tmp_path / "h*.jsonl")])
+    assert [s.host for s in streams] == [0, 1, 2, 3]
+    assert all(s.run_id == "merged-run" for s in streams)
+    a = fleet.analyze_fleet(streams)
+    assert len(a["windows"]) == 3
+    assert all(w["slowest_host"] == 3 for w in a["windows"])

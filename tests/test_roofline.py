@@ -340,6 +340,27 @@ def test_regress_degraded_exits_nonzero_and_names_metrics(tmp_path,
         and "retraces.retraces" in out
 
 
+def test_regress_json_is_schema_versioned(tmp_path, capsys):
+    """ISSUE 10 satellite: --json output is machine-readable for CI —
+    schema-versioned like timeline --json, regressions as structured
+    entries, and round-trips check_schema_version."""
+    a = _analysis()
+    bad = copy.deepcopy(a)
+    bad["steps_per_s"] /= 2.0
+    rc = regress.main([_write(tmp_path, "a.json", a),
+                       _write(tmp_path, "b.json", bad), "--json"])
+    assert rc == 1
+    diff = json.loads(capsys.readouterr().out)
+    assert diff["schema_version"] == timeline.SCHEMA_VERSION
+    timeline.check_schema_version(diff, "regress --json")
+    metrics = {e["metric"] for e in diff["regressions"]}
+    assert "steps_per_s" in metrics
+    entry = next(e for e in diff["regressions"]
+                 if e["metric"] == "steps_per_s")
+    assert set(entry) >= {"metric", "base", "cur", "ratio", "tol_pct",
+                          "direction"}
+
+
 def test_regress_rejects_future_schema_major(tmp_path, capsys):
     a = _analysis()
     fut = dict(a, schema_version="99.0")

@@ -47,10 +47,12 @@ PAGES = {
     "prof": ["apex_tpu.prof.capture", "apex_tpu.prof.parse",
              "apex_tpu.prof.analysis", "apex_tpu.prof.ledger",
              "apex_tpu.prof.trace_count", "apex_tpu.prof.timeline",
-             "apex_tpu.prof.roofline", "apex_tpu.prof.regress"],
+             "apex_tpu.prof.roofline", "apex_tpu.prof.regress",
+             "apex_tpu.prof.fleet", "apex_tpu.prof.memory"],
     "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.events",
                   "apex_tpu.telemetry.metrics",
-                  "apex_tpu.telemetry.watchdog"],
+                  "apex_tpu.telemetry.watchdog",
+                  "apex_tpu.telemetry.export"],
     "rnn_reparam": ["apex_tpu.RNN", "apex_tpu.reparameterization"],
     "contrib": ["apex_tpu.contrib.xentropy", "apex_tpu.contrib.groupbn"],
     "models": ["apex_tpu.models"],
