@@ -135,15 +135,27 @@ def abstractify(tree):
     return jax.tree_util.tree_map(one, tree)
 
 
-def signature(tree, limit: int = 16) -> Tuple[str, ...]:
+def signature(tree, limit: int = 16, *,
+              static: Tuple = ()) -> Tuple[str, ...]:
     """Shape/dtype signature of a pytree's leading leaves — the AOT
     executable lookup key (matches the retrace-event signature the
     runtime emits, so telemetry and warmup agree on what "same window"
-    means)."""
+    means).
+
+    ``static`` appends static parameters — ints/strs that specialize
+    the compile but are not array leaves (ISSUE 11 satellite: the
+    serving engine's sequence-length buckets) — so per-bucket
+    executables key cleanly into one AOT table: two calls whose array
+    signatures collide but whose bucket differs get distinct keys, and
+    a bucket never warmed is a clean lookup MISS (the caller's jit
+    fallback path), not a wrong-executable dispatch."""
     leaves = jax.tree_util.tree_leaves(tree)
-    return tuple(f"{getattr(l, 'dtype', type(l).__name__)}"
-                 f"{list(getattr(l, 'shape', ()))}"
-                 for l in leaves[:limit])
+    sig = tuple(f"{getattr(l, 'dtype', type(l).__name__)}"
+                f"{list(getattr(l, 'shape', ()))}"
+                for l in leaves[:limit])
+    if static:
+        sig = sig + tuple(f"static:{v!r}" for v in static)
+    return sig
 
 
 def warmup(jitted, *args) -> Any:

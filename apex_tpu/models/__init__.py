@@ -4,4 +4,4 @@ from .resnet import (ResNet, ResNet18, ResNet34, ResNet50,  # noqa: F401
                      ResNet101, ResNet152, BottleneckBlock, BasicBlock)
 from .bert import BertEncoder, bert_base, bert_tiny         # noqa: F401
 from .dcgan import Generator, Discriminator                 # noqa: F401
-from .gpt import GPT, gpt2_small, gpt_tiny                  # noqa: F401
+from .gpt import GPT, gpt2_small, gpt_tiny, init_cache      # noqa: F401

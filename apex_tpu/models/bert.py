@@ -60,7 +60,7 @@ class BertSelfAttention(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, *, kv_cache=None, positions=None):
         d = x.shape[-1]
         head_dim = d // self.num_heads
         n_kv = self.num_kv_heads or self.num_heads
@@ -73,6 +73,21 @@ class BertSelfAttention(nn.Module):
         q = dense("query", self.num_heads)(x)
         k = dense("key", n_kv)(x)
         v = dense("value", n_kv)(x)
+        if kv_cache is not None:
+            # External-cache incremental forward (ISSUE 11): the serving
+            # engine owns the cache buffers (paged, donated) and threads
+            # PER-SEQUENCE positions — unlike the flax "cache" collection
+            # path below, whose single scalar cache_index forces every
+            # sequence in the batch to the same position (useless for
+            # continuous batching).  Params are byte-identical to the
+            # training tree, so a hot-swapped training checkpoint drops
+            # straight in.
+            ctx, kf, vf = self._incremental(q, k, v, kv_cache, positions,
+                                            mask)
+            ctx = ctx.astype(x.dtype)
+            out = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
+                                  param_dtype=jnp.float32, name="out")(ctx)
+            return out, (kf, vf)
         if n_kv != self.num_heads and self.attention_impl not in (
                 "flash", "blockwise", "full"):
             raise ValueError(
@@ -173,6 +188,57 @@ class BertSelfAttention(nn.Module):
         ctx = ctx.astype(x.dtype)
         return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
                                param_dtype=jnp.float32, name="out")(ctx)
+
+    def _incremental(self, q, k, v, kv_cache, positions, mask):
+        """Incremental attention over an externally-owned dense cache
+        view (ISSUE 11): write the fresh tokens' k/v at each sequence's
+        own position, attend causally over everything written so far.
+
+        ``kv_cache``: ``(k, v)`` dense views ``[B, L, n_kv, head_dim]``
+        (the serving engine gathers these from its page pool);
+        ``positions``: ``[B]`` int32, the global position of each
+        sequence's FIRST fresh token.  Returns ``(ctx, k_full, v_full)``
+        with the updated dense views — the caller scatters the written
+        rows back to its pages.  The caller bounds ``positions + T`` by
+        the cache length (past it ``dynamic_update_slice`` clamps
+        silently, same contract as the flax-cache decode path)."""
+        from ..ops.flash_attention import flash_attention
+        if not self.causal or mask is not None:
+            raise ValueError("the external-cache incremental path is "
+                             "causal-only and takes no padding mask")
+        ck, cv = kv_cache
+        b_, t_ = q.shape[0], q.shape[1]
+        cache_len = ck.shape[1]
+        positions = jnp.asarray(positions, jnp.int32)
+        write = jax.vmap(
+            lambda c, fresh, p: jax.lax.dynamic_update_slice(
+                c, fresh.astype(c.dtype), (p, 0, 0)))
+        kf = write(ck, k, positions)
+        vf = write(cv, v, positions)
+        key_pos = jnp.arange(cache_len)
+        if t_ == 1:
+            # decode: one fresh token per sequence — the suffix-aligned
+            # decode path of flash_attention; key_padding_bias masks the
+            # dead cache tail (and the out-of-window past).
+            live = key_pos[None, :] <= positions[:, None]
+            if self.window is not None:
+                live = jnp.logical_and(
+                    live, key_pos[None, :] > positions[:, None] - self.window)
+            kb = jnp.where(live, 0.0, -1e9)
+            ctx = flash_attention(q, kf, vf, causal=True,
+                                  key_padding_bias=kb)
+        else:
+            # prefill: per-sequence position offsets need a per-row
+            # causal frontier — an explicit [B, T, L] visibility bias.
+            qpos = positions[:, None] + jnp.arange(t_)[None, :]   # [B, T]
+            visible = key_pos[None, None, :] <= qpos[:, :, None]
+            if self.window is not None:
+                visible = jnp.logical_and(
+                    visible,
+                    key_pos[None, None, :] > qpos[:, :, None] - self.window)
+            bias = jnp.where(visible, 0.0, -1e9)
+            ctx = flash_attention(q, kf, vf, causal=False, bias=bias)
+        return ctx, kf, vf
 
 
 class BertLayer(nn.Module):

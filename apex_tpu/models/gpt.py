@@ -32,18 +32,23 @@ class GPTBlock(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, kv_cache=None, positions=None):
         d = x.shape[-1]
         h = FusedLayerNorm(normalized_shape=d, name="ln1")(x).astype(x.dtype)
         from .bert import BertSelfAttention
-        h = BertSelfAttention(self.num_heads, self.dtype,
-                              attention_impl=self.attention_impl,
-                              sp_axis=self.sp_axis, causal=True,
-                              num_kv_heads=self.num_kv_heads,
-                              window=self.window,
-                              decode=self.decode,
-                              cache_len=self.cache_len,
-                              name="attention")(h)
+        attn = BertSelfAttention(self.num_heads, self.dtype,
+                                 attention_impl=self.attention_impl,
+                                 sp_axis=self.sp_axis, causal=True,
+                                 num_kv_heads=self.num_kv_heads,
+                                 window=self.window,
+                                 decode=self.decode,
+                                 cache_len=self.cache_len,
+                                 name="attention")
+        new_cache = None
+        if kv_cache is not None:
+            h, new_cache = attn(h, kv_cache=kv_cache, positions=positions)
+        else:
+            h = attn(h)
         x = x + h
         h = FusedLayerNorm(normalized_shape=d, name="ln2")(x).astype(x.dtype)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
@@ -51,6 +56,8 @@ class GPTBlock(nn.Module):
         h = nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
         h = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
                      name="mlp_down")(h)
+        if new_cache is not None:
+            return x + h, new_cache
         return x + h
 
 
@@ -71,12 +78,44 @@ class GPT(nn.Module):
     decode: bool = False                 # KV-cache autoregressive decode
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, *, kv_caches=None, positions=None):
         b, t = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (self.vocab_size, self.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (self.max_len, self.hidden_size), jnp.float32)
+        if kv_caches is not None:
+            # Incremental forward over externally-owned caches (ISSUE
+            # 11): ``kv_caches`` is one ``(k, v)`` dense view per layer
+            # ([B, L, n_kv, head_dim] — :func:`init_cache` builds them,
+            # the serving engine gathers them from its page pool) and
+            # ``positions`` [B] int32 the per-sequence position of the
+            # first fresh token.  T may be 1 (decode) or a prompt
+            # bucket (prefill).  Returns ``(logits [B, T, V],
+            # new_caches)`` — the caller owns persisting the updates.
+            if len(kv_caches) != self.num_layers:
+                raise ValueError(
+                    f"kv_caches has {len(kv_caches)} entries for "
+                    f"{self.num_layers} layers")
+            if positions is None:
+                positions = jnp.zeros((b,), jnp.int32)
+            pos = positions[:, None] + jnp.arange(t)[None, :]    # [B, T]
+            x = (wte[input_ids] + wpe[pos]).astype(self.dtype)
+            new_caches = []
+            for i in range(self.num_layers):
+                x, c = GPTBlock(self.num_heads, self.mlp_dim, self.dtype,
+                                attention_impl=self.attention_impl,
+                                sp_axis=None,
+                                num_kv_heads=self.num_kv_heads,
+                                window=self.window,
+                                name=f"block_{i}")(
+                                    x, kv_cache=kv_caches[i],
+                                    positions=positions)
+                new_caches.append(c)
+            x = FusedLayerNorm(normalized_shape=self.hidden_size,
+                               name="ln_f")(x)
+            logits = (x.astype(jnp.float32) @ wte.T).astype(jnp.float32)
+            return logits, new_caches
         # Checked at trace time — JAX gather clamps out-of-range indices,
         # so an oversized (global) sequence would silently reuse the last
         # position embedding instead of erroring.
@@ -131,6 +170,31 @@ def gpt_tiny(**kw):
     kw.setdefault("mlp_dim", 256)
     kw.setdefault("max_len", 256)
     return GPT(**kw)
+
+
+def init_cache(model: GPT, batch_size: int, *,
+               cache_len: Optional[int] = None, dtype=None):
+    """Zeroed external KV-cache views for the incremental forward
+    (ISSUE 11): one ``(k, v)`` pair per layer, each
+    ``[batch_size, cache_len, n_kv_heads, head_dim]``.
+
+    This is the DENSE view shape ``model.apply(..., kv_caches=...,
+    positions=...)`` consumes; the serving engine's paged pool gathers
+    into (and scatters out of) exactly this shape per step.  GQA models
+    cache only the kv heads — the memory saving is real.  ``cache_len``
+    defaults to ``model.max_len`` and must not exceed it (positions past
+    it have no learned embedding).  ``dtype`` defaults to the model's
+    compute dtype."""
+    cache_len = model.max_len if cache_len is None else int(cache_len)
+    if cache_len > model.max_len:
+        raise ValueError(f"cache_len {cache_len} exceeds the model's "
+                         f"max_len {model.max_len}")
+    n_kv = model.num_kv_heads or model.num_heads
+    head_dim = model.hidden_size // model.num_heads
+    dt = model.dtype if dtype is None else dtype
+    shape = (batch_size, cache_len, n_kv, head_dim)
+    return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+            for _ in range(model.num_layers)]
 
 
 def generate(model: GPT, params, prompt_ids, max_new_tokens: int, *,

@@ -737,32 +737,34 @@ def _flash_bwd_pallas(q, k, v, kbias, out, lse, do, *, sm_scale, causal,
 
 # -- custom VJP over the head-major layout -------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, kbias, qkbias, sm_scale, causal, window, block_q,
-           block_k, interpret):
+           block_k, interpret, q_offset):
     out, _ = _flash_fwd_pallas(q, k, v, kbias, qk_bias=qkbias,
                                sm_scale=sm_scale, causal=causal,
                                window=window, block_q=block_q,
-                               block_k=block_k, interpret=interpret)
+                               block_k=block_k, q_offset=q_offset,
+                               interpret=interpret)
     return out
 
 
 def _flash_fwd_rule(q, k, v, kbias, qkbias, sm_scale, causal, window,
-                    block_q, block_k, interpret):
+                    block_q, block_k, interpret, q_offset):
     out, lse = _flash_fwd_pallas(q, k, v, kbias, qk_bias=qkbias,
                                  sm_scale=sm_scale, causal=causal,
                                  window=window, block_q=block_q,
-                                 block_k=block_k, interpret=interpret)
+                                 block_k=block_k, q_offset=q_offset,
+                                 interpret=interpret)
     return out, (q, k, v, kbias, qkbias, out, lse)
 
 
 def _flash_bwd_rule(sm_scale, causal, window, block_q, block_k, interpret,
-                    res, do):
+                    q_offset, res, do):
     q, k, v, kbias, qkbias, out, lse = res
     dq, dk, dv, dbias, dbias2 = _flash_bwd_pallas(
         q, k, v, kbias, out, lse, do, sm_scale=sm_scale, causal=causal,
         window=window, block_q=block_q, block_k=block_k, qk_bias=qkbias,
-        interpret=interpret)
+        q_offset=q_offset, interpret=interpret)
     return dq, dk, dv, dbias, dbias2
 
 
@@ -806,6 +808,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
     On TPU (or with ``interpret=True``) runs the Pallas
     kernels; otherwise — or when the sequence doesn't tile — falls back to
     the jnp blockwise path, which computes the same function.
+
+    **Decode-shaped inputs** (ISSUE 11 satellite): ``causal=True`` with
+    ``q_len < kv_len`` treats the queries as the SUFFIX of the key
+    sequence — query row ``i`` sits at global position
+    ``kv_len - q_len + i`` — the KV-cache decode convention (a q_len=1
+    call is one fresh token attending every cached key).  A q_len of 1
+    (or any length below the kernel block size) dispatches to the
+    correctly-masked jnp path; mask dead cache tail entries with
+    ``key_padding_bias``.  ``q_len > kv_len`` under causal raises.
     """
     tq, tk = q.shape[1], k.shape[1]
     d = q.shape[-1]
@@ -814,6 +825,23 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError(
             f"kv heads must divide query heads and match between k and v; "
             f"got q heads {n_heads}, k heads {n_kv}, v heads {v.shape[2]}")
+    # Decode-shaped causal inputs (ISSUE 11 satellite): with fewer
+    # queries than keys, the queries are the SUFFIX of the sequence —
+    # the last tq positions (the KV-cache decode convention: one fresh
+    # token attending a cache of tk past keys).  Before this fix the
+    # masked paths treated query row 0 as global position 0, so a
+    # causal q_len=1 call silently attended only key 0.  Suffix
+    # alignment makes causal+cross-length a correct masked path on
+    # BOTH the kernel and jnp routes (q_offset is a static int, so the
+    # kernels bake it as a constant — no SMEM operands).
+    q_offset = 0
+    if causal and tq != tk:
+        if tq > tk:
+            raise ValueError(
+                f"causal attention needs q_len <= kv_len (queries are "
+                f"the suffix of the key sequence); got q_len {tq} > "
+                f"kv_len {tk}")
+        q_offset = tk - tq
     if window is not None:
         if not causal:
             raise ValueError("window requires causal=True (sliding-window "
@@ -897,7 +925,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
             v = jnp.repeat(v, n_heads // n_kv, axis=2)
         if window is not None:   # sliding window as an additive band bias
             wb = jnp.where(
-                (jnp.arange(tq)[:, None] - jnp.arange(tk)[None, :]) < window,
+                ((q_offset + jnp.arange(tq))[:, None]
+                 - jnp.arange(tk)[None, :]) < window,
                 0.0, NEG_INF).astype(jnp.float32)
             b4 = wb[None, None] if b4 is None else b4 + wb[None, None]
         # Shape-dispatched short-seq case: one whole-array block (the
@@ -905,7 +934,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
         # 512-blocks would only add online-softmax carry overhead here.
         bs = tk if tk < _KERNEL_MIN_KV else 512
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                                   bias=b4, block_size=bs)
+                                   bias=b4, block_size=bs,
+                                   q_offset=q_offset)
 
     qt = q.transpose(0, 2, 1, 3)                         # [B, H, T, D]
     kt = k.transpose(0, 2, 1, 3)
@@ -916,5 +946,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
     # would double its HBM footprint) — the kernels widen each block.
     out = _flash(qt, kt, vt, kb, bias, float(sm_scale), bool(causal),
                  None if window is None else int(window),
-                 int(bq), int(bk), bool(interpret))
+                 int(bq), int(bk), bool(interpret), int(q_offset))
     return out.transpose(0, 2, 1, 3)

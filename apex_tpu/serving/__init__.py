@@ -1,0 +1,15 @@
+"""apex_tpu.serving — AOT-bucketed inference on the training stack
+(ISSUE 11): continuous batching over a block-paged, donated KV cache,
+zero steady-state compiles, zero-downtime weight hot-swap, and
+per-request telemetry through the existing recorder/Prometheus export.
+
+See ``docs/serving.md`` for the recipe and the gauge table.
+"""
+
+from .engine import (Completion, Request, ServedResult,  # noqa: F401
+                     ServingEngine)
+from .hotswap import WeightWatcher                       # noqa: F401
+from .kv_cache import PageAllocator, make_pool           # noqa: F401
+
+__all__ = ["ServingEngine", "Request", "ServedResult", "Completion",
+           "WeightWatcher", "PageAllocator", "make_pool"]

@@ -1,0 +1,650 @@
+"""AOT-bucketed inference engine: continuous batching over a paged KV
+cache with zero steady-state compiles (ISSUE 11 tentpole).
+
+The training stack built everything this engine needs — it just needs
+them pointed at requests instead of batches:
+
+* **zero compiles in steady state** — prefill and single-token decode
+  are AOT-lowered per sequence-length bucket BEFORE the first request
+  (``jax.jit(...).lower().compile()`` over abstract shapes, the
+  :mod:`apex_tpu.cache` warmup machinery); dispatches go straight to the
+  compiled executables, keyed by
+  :func:`apex_tpu.cache.signature(..., static=(kind, bucket))`.  A
+  bucket that was never warmed is a clean lookup MISS served by the jit
+  path (one compile, identical numerics) and counted in
+  ``stats["aot_misses"]`` — never a wrong-executable dispatch;
+* **continuous batching** — a bounded request queue (the
+  :class:`~apex_tpu.data.PrefetchLoader` back-pressure discipline:
+  ``submit`` blocks when the queue is full) feeds a scheduler that
+  admits requests into free KV pages at every step boundary, runs ONE
+  batched decode dispatch for every active sequence regardless of how
+  staggered their positions are (the per-sequence ``positions`` of the
+  GPT incremental forward), and evicts finished sequences immediately —
+  a finishing chat frees its pages for the next admission without
+  waiting for its batch peers;
+* **paged, donated KV cache** — :mod:`apex_tpu.serving.kv_cache`: the
+  pool arrays are donated through every prefill/decode dispatch, so the
+  cache never pays a copy across steps;
+* **weight hot-swap** — a :class:`~apex_tpu.serving.hotswap.WeightWatcher`
+  stages newly committed training checkpoints in the background and the
+  scheduler swaps the params reference between decode steps: zero
+  downtime, no failed requests, and every post-swap token comes from
+  the new weights;
+* **per-request observability** — queue-wait / prefill / per-token
+  decode spans as ``serving`` telemetry events, and live
+  ``serving_queue_depth`` / ``serving_active_seqs`` /
+  ``serving_kv_page_occupancy_pct`` / ``serving_tokens_per_s`` gauges
+  through the existing recorder into the Prometheus export; the
+  ``serving_queue_stall`` watchdog rule folds the admit events.
+
+Decoding is greedy (``argmax``) — deliberately: bitwise-reproducible
+outputs are what make the hot-swap acceptance gate (post-swap output ==
+the new checkpoint's single-request output) and the continuous-batching
+parity tests meaningful.  Sampling belongs to a later PR.
+
+Usage::
+
+    from apex_tpu import serving
+
+    eng = serving.ServingEngine(model, params, buckets=(128, 256),
+                                max_seqs=8, watch_dir=ckpt_dir)
+    eng.warmup()                        # AOT: all buckets, before traffic
+    results = eng.generate([prompt_a, prompt_b], max_new_tokens=64)
+    eng.close()
+
+or threaded: ``eng.start()`` + ``eng.submit(...).result()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import cache as _cache
+from .. import telemetry as _telemetry
+from . import kv_cache as _kv
+from .hotswap import WeightWatcher
+
+__all__ = ["Request", "ServedResult", "Completion", "ServingEngine"]
+
+
+class Request(NamedTuple):
+    """One generation request: ``prompt`` int32 token ids ``[T]``,
+    ``max_new_tokens`` the decode budget, ``stop_token`` an optional
+    early-finish id (checked on sampled tokens)."""
+    prompt: np.ndarray
+    max_new_tokens: int
+    stop_token: Optional[int] = None
+
+
+class ServedResult(NamedTuple):
+    """A finished request: generated ``tokens`` (prompt excluded),
+    timing spans, and ``error`` (None on success — a rejection, e.g. a
+    prompt that fits no bucket, reports here instead of raising on the
+    serving thread)."""
+    tokens: np.ndarray
+    timings: dict
+    bucket: Optional[int] = None
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class Completion:
+    """Future-ish handle for a submitted request."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result: Optional[ServedResult] = None
+
+    def _set(self, result: ServedResult) -> None:
+        self._result = result
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServedResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError("request not finished")
+        return self._result
+
+
+class _Active(NamedTuple):
+    """One admitted sequence (a batch slot)."""
+    request: Request
+    completion: Completion
+    bucket: int
+    pages: List[int]
+    t_submit: float
+    t_admit: float
+    t_prefill_done: float
+
+
+class ServingEngine:
+    """Continuous-batching engine for a
+    :class:`~apex_tpu.models.gpt.GPT` model (see module docstring).
+
+    ``buckets`` are the sequence-length capacities prefill AND decode
+    specialize on (each must divide by ``page_size`` and fit
+    ``model.max_len``); a request takes the smallest bucket holding
+    ``len(prompt) + max_new_tokens``.  ``max_seqs`` is the decode batch
+    width; ``n_pages`` sizes the pool (default: enough for ``max_seqs``
+    sequences of the largest bucket, plus the trash page).
+
+    ``watch_dir`` enables weight hot-swap: a
+    :class:`~apex_tpu.serving.hotswap.WeightWatcher` on that checkpoint
+    directory (``extract`` maps its :class:`~apex_tpu.checkpoint.Restored`
+    to the params tree), polled by a background thread
+    (``poll_every_s``) and swapped between steps."""
+
+    def __init__(self, model, params, *,
+                 buckets: Sequence[int] = (128, 256),
+                 page_size: int = 16,
+                 max_seqs: int = 4,
+                 n_pages: Optional[int] = None,
+                 max_queue: int = 64,
+                 cache_dtype=None,
+                 watch_dir: Optional[str] = None,
+                 extract: Optional[Callable] = None,
+                 poll_every_s: float = 1.0,
+                 watch_from_step: Optional[int] = None,
+                 telemetry=None):
+        buckets = sorted(int(b) for b in buckets)
+        if not buckets:
+            raise ValueError("need at least one sequence-length bucket")
+        for b in buckets:
+            if b % page_size:
+                raise ValueError(f"bucket {b} must divide by page_size "
+                                 f"{page_size}")
+            if b > model.max_len:
+                raise ValueError(f"bucket {b} exceeds model.max_len "
+                                 f"{model.max_len}")
+        self.model = model
+        self.params = params
+        self.buckets = tuple(buckets)
+        self.page_size = int(page_size)
+        self.max_seqs = int(max_seqs)
+        if n_pages is None:
+            n_pages = 1 + self.max_seqs * (buckets[-1] // page_size)
+        self.pool_k, self.pool_v = _kv.make_pool(
+            model, n_pages, page_size, dtype=cache_dtype)
+        self.pages = _kv.PageAllocator(n_pages)
+        self._slots: List[Optional[_Active]] = [None] * self.max_seqs
+        # per-slot decode state (host): current write position, last
+        # sampled token, generated tokens so far
+        self._pos = np.zeros((self.max_seqs,), np.int32)
+        self._tok = np.zeros((self.max_seqs,), np.int32)
+        self._gen: List[List[int]] = [[] for _ in range(self.max_seqs)]
+        # bounded request queue (PrefetchLoader-style back-pressure)
+        self.max_queue = int(max_queue)
+        self._queue: List[tuple] = []          # (Request, Completion, t)
+        self._qlock = threading.Lock()
+        self._qcond = threading.Condition(self._qlock)
+        # jit callables + AOT executables, keyed per (kind, bucket)
+        self._jit: dict = {}
+        self._aot: dict = {}
+        self.stats = {"submitted": 0, "completed": 0, "rejected": 0,
+                      "aot_misses": 0, "hotswaps": 0, "tokens_out": 0,
+                      "decode_steps": 0, "prefills": 0}
+        self._telemetry = telemetry
+        self._t_rate = None                    # tokens/s gauge anchor
+        self.watcher: Optional[WeightWatcher] = None
+        if watch_dir is not None:
+            # watch_from_step: the checkpoint step `params` came from
+            # (when it came from this same directory), so the watcher
+            # only stages checkpoints NEWER than what is already serving.
+            self.watcher = WeightWatcher(
+                watch_dir, like=params, extract=extract,
+                poll_every_s=poll_every_s,
+                initial_step=watch_from_step,
+                telemetry=telemetry).start()
+        self._serve_stop = threading.Event()
+        self._serve_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- telemetry ----------------------------------------------------------
+    def _rec(self):
+        return (self._telemetry if self._telemetry is not None
+                else _telemetry.get_recorder())
+
+    def _event(self, phase: str, **fields) -> None:
+        rec = self._rec()
+        if rec is not None:
+            rec.event("serving", phase=phase, **fields)
+
+    def _gauges(self) -> None:
+        rec = self._rec()
+        if rec is None:
+            return
+        with self._qlock:
+            depth = len(self._queue)
+        rec.metrics.gauge("serving_queue_depth").set(depth)
+        rec.metrics.gauge("serving_active_seqs").set(
+            sum(1 for s in self._slots if s is not None))
+        rec.metrics.gauge("serving_kv_page_occupancy_pct").set(
+            self.pages.occupancy_pct)
+
+    # -- bucketed step programs ---------------------------------------------
+    def _bucket_for(self, total_len: int) -> Optional[int]:
+        for b in self.buckets:
+            if total_len <= b:
+                return b
+        return None
+
+    def _prefill_jit(self, bucket: int):
+        fn = self._jit.get(("prefill", bucket))
+        if fn is None:
+            model = self.model
+            n_kv = model.num_kv_heads or model.num_heads
+            head_dim = model.hidden_size // model.num_heads
+            cdtype = self.pool_k.dtype
+
+            def prefill(params, pool_k, pool_v, pages, tokens, length):
+                # tokens [1, bucket]; pages [bucket/page]; length scalar
+                zeros = [(jnp.zeros((1, bucket, n_kv, head_dim), cdtype),
+                          jnp.zeros((1, bucket, n_kv, head_dim), cdtype))
+                         for _ in range(model.num_layers)]
+                logits, caches = model.apply(
+                    {"params": params}, tokens, kv_caches=zeros,
+                    positions=jnp.zeros((1,), jnp.int32))
+                k_dense = jnp.stack([k[0] for k, _ in caches])
+                v_dense = jnp.stack([v[0] for _, v in caches])
+                pool_k = _kv.scatter_prefill(pool_k, pages, k_dense)
+                pool_v = _kv.scatter_prefill(pool_v, pages, v_dense)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], length - 1, axis=0, keepdims=False)
+                nxt = jnp.argmax(last, -1).astype(jnp.int32)
+                return pool_k, pool_v, nxt
+
+            fn = jax.jit(prefill, donate_argnums=(1, 2))
+            self._jit[("prefill", bucket)] = fn
+        return fn
+
+    def _decode_jit(self, bucket: int):
+        fn = self._jit.get(("decode", bucket))
+        if fn is None:
+            model, page = self.model, self.page_size
+
+            def decode(params, pool_k, pool_v, tables, positions, tokens):
+                # tables [S, bucket/page]; positions/tokens [S]
+                caches = _kv.gather_views(pool_k, pool_v, tables)
+                logits, new = model.apply(
+                    {"params": params}, tokens[:, None],
+                    kv_caches=caches, positions=positions)
+                idx = positions[:, None, None, None]
+
+                def tok_rows(dense):
+                    # [S, bucket, n_kv, hd] -> this step's row per slot
+                    return jnp.take_along_axis(dense, idx, axis=1)[:, 0]
+
+                k_tok = jnp.stack([tok_rows(k) for k, _ in new])
+                v_tok = jnp.stack([tok_rows(v) for _, v in new])
+                pid = jnp.take_along_axis(
+                    tables, (positions // page)[:, None], axis=1)[:, 0]
+                off = positions % page
+                pool_k = _kv.scatter_token(pool_k, pid, off, k_tok)
+                pool_v = _kv.scatter_token(pool_v, pid, off, v_tok)
+                nxt = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+                return pool_k, pool_v, nxt
+
+            fn = jax.jit(decode, donate_argnums=(1, 2))
+            self._jit[("decode", bucket)] = fn
+        return fn
+
+    def _dispatch(self, kind: str, bucket: int, args: tuple):
+        """AOT fast path with jit lookup-miss fallback: the compiled
+        executable for (kind, bucket) if warmed, else the jit callable
+        (one compile, counted — identical numerics either way)."""
+        key = _cache.signature(args, static=(kind, bucket))
+        compiled = self._aot.get(key)
+        jit_fn = (self._prefill_jit if kind == "prefill"
+                  else self._decode_jit)(bucket)
+        if compiled is not None:
+            try:
+                return compiled(*args)
+            except (ValueError, TypeError):
+                # layout/sharding drift: drop the stale entry, let jit
+                # handle anything (same contract as runtime._AotLoop)
+                self._aot.pop(key, None)
+        self.stats["aot_misses"] += 1
+        return jit_fn(*args)
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None
+               ) -> "ServingEngine":
+        """AOT-compile prefill + decode for every bucket BEFORE traffic
+        (``lower().compile()`` over abstract shapes — nothing runs,
+        nothing is donated).  With :func:`apex_tpu.cache.enable` the
+        backend compiles are disk hits on the second process start.
+        After this, steady-state serving pays ZERO compiles: pin with
+        ``prof.assert_trace_count`` on the engine's jit callables."""
+        s = self.max_seqs
+        for b in (self.buckets if buckets is None else buckets):
+            n_pages_b = b // self.page_size
+            pre_args = (self.params, self.pool_k, self.pool_v,
+                        np.zeros((n_pages_b,), np.int32),
+                        np.zeros((1, b), np.int32),
+                        np.int32(1))
+            key = _cache.signature(pre_args, static=("prefill", b))
+            self._aot[key] = _cache.warmup(self._prefill_jit(b), *pre_args)
+            dec_args = (self.params, self.pool_k, self.pool_v,
+                        np.zeros((s, n_pages_b), np.int32),
+                        np.zeros((s,), np.int32),
+                        np.zeros((s,), np.int32))
+            key = _cache.signature(dec_args, static=("decode", b))
+            self._aot[key] = _cache.warmup(self._decode_jit(b), *dec_args)
+        return self
+
+    # -- request intake ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *,
+               stop_token: Optional[int] = None,
+               block: bool = True,
+               timeout: Optional[float] = None) -> Completion:
+        """Enqueue one request; returns its :class:`Completion`.
+
+        The queue is bounded (``max_queue``): when full, ``block=True``
+        waits (back-pressure onto the caller, the PrefetchLoader
+        discipline) and ``block=False`` raises ``queue.Full``-style
+        ``RuntimeError``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        req = Request(prompt, int(max_new_tokens), stop_token)
+        comp = Completion()
+        with self._qcond:
+            # closed-check under the SAME lock close() drains under — a
+            # request appended after the drain would strand its caller
+            if self._closed:
+                raise RuntimeError("ServingEngine is closed")
+            while len(self._queue) >= self.max_queue:
+                if not block:
+                    raise RuntimeError(
+                        f"request queue full ({self.max_queue})")
+                if not self._qcond.wait(timeout=timeout or 30.0):
+                    raise TimeoutError("request queue stayed full")
+                if self._closed:
+                    raise RuntimeError("ServingEngine is closed")
+            self._queue.append((req, comp, time.perf_counter()))
+            depth = len(self._queue)
+        self.stats["submitted"] += 1
+        self._event("submit", prompt_len=int(prompt.size),
+                    max_new=int(max_new_tokens), queue_depth=depth)
+        rec = self._rec()
+        if rec is not None:
+            rec.metrics.gauge("serving_queue_depth").set(depth)
+        return comp
+
+    # -- scheduler ----------------------------------------------------------
+    def step(self) -> bool:
+        """One scheduler iteration: adopt staged weights, admit what
+        fits, run one batched decode step.  Returns True when any work
+        was done (the serve thread idles briefly otherwise)."""
+        did = self._adopt_weights()
+        did = self._admit() or did
+        did = self._decode_once() or did
+        self._gauges()
+        return did
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        """Drive :meth:`step` until queue and slots are empty (the
+        synchronous harness tests and the bench load generator use).
+        Refuses to run beside an active :meth:`start` thread — two
+        drivers would race the scheduler state and the DONATED pool
+        buffers (the second dispatch would consume deleted arrays)."""
+        if self._serve_thread is not None and self._serve_thread.is_alive():
+            raise RuntimeError(
+                "run_until_idle() cannot drive the scheduler while the "
+                "start() serve thread is running — submit() and wait on "
+                "the Completions instead")
+        for _ in range(max_steps):
+            with self._qlock:
+                queued = len(self._queue)
+            active = any(s is not None for s in self._slots)
+            if not queued and not active:
+                return
+            self.step()
+        raise RuntimeError(f"not idle after {max_steps} scheduler steps")
+
+    def generate(self, prompts: Sequence, max_new_tokens: int, *,
+                 timeout: Optional[float] = 600.0,
+                 **kw) -> List[ServedResult]:
+        """Closed-loop convenience: submit every prompt, wait for all,
+        return results in order.  With the :meth:`start` thread running
+        it only submits and waits; otherwise it drives the scheduler
+        on this thread."""
+        threaded = (self._serve_thread is not None
+                    and self._serve_thread.is_alive())
+        comps = [self.submit(p, max_new_tokens, **kw) for p in prompts]
+        if not threaded:
+            self.run_until_idle()
+        return [c.result(timeout=timeout if threaded else 0)
+                for c in comps]
+
+    def _adopt_weights(self) -> bool:
+        if self.watcher is None:
+            return False
+        staged = self.watcher.take()
+        if staged is None:
+            return False
+        step, params = staged
+        self.params = params
+        self.stats["hotswaps"] += 1
+        self._event("hotswap", step=step,
+                    in_flight=sum(1 for s in self._slots if s is not None))
+        return True
+
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            free_slot = next((i for i, s in enumerate(self._slots)
+                              if s is None), None)
+            if free_slot is None:
+                break
+            with self._qcond:
+                if not self._queue:
+                    break
+                req, comp, t_submit = self._queue[0]
+                bucket = self._bucket_for(req.prompt.size
+                                          + req.max_new_tokens)
+                if bucket is None:
+                    # fits no bucket: reject (never silently truncate)
+                    self._queue.pop(0)
+                    self._qcond.notify_all()
+                    reject = True
+                else:
+                    pages = self.pages.alloc(bucket // self.page_size)
+                    if pages is None:
+                        break           # no pages free: wait for evictions
+                    self._queue.pop(0)
+                    self._qcond.notify_all()
+                    reject = False
+            if reject:
+                self.stats["rejected"] += 1
+                self._event("reject", prompt_len=int(req.prompt.size),
+                            max_new=req.max_new_tokens)
+                comp._set(ServedResult(
+                    tokens=np.zeros((0,), np.int32), timings={},
+                    error=f"prompt {req.prompt.size} + max_new "
+                          f"{req.max_new_tokens} fits no bucket "
+                          f"(max {self.buckets[-1]})"))
+                continue
+            self._prefill_into(free_slot, req, comp, t_submit, bucket,
+                               pages)
+            admitted = True
+        return admitted
+
+    def _prefill_into(self, slot: int, req: Request, comp: Completion,
+                      t_submit: float, bucket: int,
+                      pages: List[int]) -> None:
+        t_admit = time.perf_counter()
+        queue_wait = t_admit - t_submit
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :req.prompt.size] = req.prompt
+        args = (self.params, self.pool_k, self.pool_v,
+                np.asarray(pages, np.int32), tokens,
+                np.int32(req.prompt.size))
+        self.pool_k, self.pool_v, nxt = self._dispatch(
+            "prefill", bucket, args)
+        # Response boundary: the first sampled token must reach the host
+        # — it seeds the decode batch and may already finish the request.
+        first = int(np.asarray(nxt))  # jaxlint: disable=J001,J012 -- the sanctioned response-boundary sync: prefill's sampled token seeds the decode batch and the scheduler's admission/termination decisions are host control flow
+        t_done = time.perf_counter()
+        self.stats["prefills"] += 1
+        self._event("admit", slot=slot, bucket=bucket,
+                    prompt_len=int(req.prompt.size),
+                    queue_wait=round(queue_wait, 6),
+                    prefill_dur=round(t_done - t_admit, 6))
+        rec = self._rec()
+        if rec is not None:
+            rec.metrics.histogram("serving_queue_wait_s").observe(
+                queue_wait)
+            rec.metrics.histogram("serving_prefill_s").observe(
+                t_done - t_admit)
+        self._slots[slot] = _Active(req, comp, bucket, pages,
+                                    t_submit, t_admit, t_done)
+        self._pos[slot] = req.prompt.size
+        self._tok[slot] = first
+        self._gen[slot] = [first]
+        if req.max_new_tokens == 1 or first == req.stop_token:
+            self._finish(slot)
+
+    def _decode_once(self) -> bool:
+        live = [i for i, s in enumerate(self._slots) if s is not None]
+        if not live:
+            return False
+        # one batched dispatch at the smallest bucket covering every
+        # live sequence's NEXT position — short traffic keeps small
+        # executables even while a long sequence occupies a slot
+        bucket = self._bucket_for(int(max(self._pos[i] for i in live)) + 1)
+        n_pages_b = bucket // self.page_size
+        tables = np.zeros((self.max_seqs, n_pages_b), np.int32)
+        for i in live:
+            row = self.pages.padded_row(self._slots[i].pages, n_pages_b)
+            tables[i] = row[:n_pages_b]
+        t0 = time.perf_counter()
+        args = (self.params, self.pool_k, self.pool_v, tables,
+                self._pos.copy(), self._tok.copy())
+        self.pool_k, self.pool_v, nxt = self._dispatch(
+            "decode", bucket, args)
+        self._handle_decoded(nxt, live, bucket, t0)
+        return True
+
+    def _handle_decoded(self, nxt, live: List[int], bucket: int,
+                        t0: float) -> None:
+        """Fold one decode dispatch's sampled tokens back into the
+        scheduler (the per-step response boundary)."""
+        toks = np.asarray(nxt)  # jaxlint: disable=J001,J012 -- the sanctioned response-boundary sync: sampled tokens drive termination/eviction/admission (host control flow) and stream back to waiting callers
+        dur = time.perf_counter() - t0
+        self.stats["decode_steps"] += 1
+        n_tok = len(live)
+        self.stats["tokens_out"] += n_tok
+        for i in live:
+            self._pos[i] += 1
+            tok = int(toks[i])
+            self._tok[i] = tok
+            self._gen[i].append(tok)
+            act = self._slots[i]
+            if (len(self._gen[i]) >= act.request.max_new_tokens
+                    or tok == act.request.stop_token):
+                self._finish(i)
+        rec = self._rec()
+        self._event("decode", active=n_tok, bucket=bucket,
+                    dur=round(dur, 6))
+        if rec is not None:
+            rec.metrics.histogram("serving_decode_step_s").observe(dur)
+            now = time.perf_counter()
+            if self._t_rate is not None:
+                rec.metrics.gauge("serving_tokens_per_s").set(
+                    n_tok / max(now - self._t_rate, 1e-9))
+            self._t_rate = now
+
+    def _finish(self, slot: int) -> None:
+        act = self._slots[slot]
+        gen = self._gen[slot]
+        req = act.request
+        if req.stop_token is not None and req.stop_token in gen:
+            gen = gen[:gen.index(req.stop_token) + 1]
+        t_done = time.perf_counter()
+        decode_s = t_done - act.t_prefill_done
+        timings = {
+            "queue_wait_s": round(act.t_admit - act.t_submit, 6),
+            "prefill_s": round(act.t_prefill_done - act.t_admit, 6),
+            "decode_s": round(decode_s, 6),
+            "total_s": round(t_done - act.t_submit, 6),
+            "tok_per_s": (round((len(gen) - 1) / decode_s, 2)
+                          if decode_s > 0 and len(gen) > 1 else None),
+        }
+        self.pages.free(act.pages)
+        self._slots[slot] = None
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._gen[slot] = []
+        self.stats["completed"] += 1
+        self._event("done", slot=slot, bucket=act.bucket,
+                    n_tokens=len(gen), **timings)
+        act.completion._set(ServedResult(
+            tokens=np.asarray(gen, np.int32), timings=timings,
+            bucket=act.bucket))
+
+    # -- threaded serving ----------------------------------------------------
+    def start(self) -> "ServingEngine":
+        """Run the scheduler on a background thread (idempotent): the
+        deployment shape — callers just :meth:`submit` and wait."""
+        if self._serve_thread is None or not self._serve_thread.is_alive():
+            self._serve_stop.clear()
+            self._serve_thread = threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name="apex-tpu-serving")
+            self._serve_thread.start()
+        return self
+
+    def _serve_loop(self) -> None:
+        while not self._serve_stop.is_set():
+            if not self.step():
+                self._serve_stop.wait(0.002)    # idle: don't spin
+
+    def close(self) -> None:
+        """Stop the serve thread and the weight watcher; fail queued
+        AND in-flight (admitted) requests so no caller waits forever,
+        and return their KV pages to the pool."""
+        with self._qcond:
+            if self._closed:
+                return
+            self._closed = True
+        self._serve_stop.set()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+            self._serve_thread = None
+        if self.watcher is not None:
+            self.watcher.close()
+        with self._qcond:
+            abandoned, self._queue = self._queue, []
+            self._qcond.notify_all()
+        closed = ServedResult(tokens=np.zeros((0,), np.int32),
+                              timings={}, error="engine closed")
+        for _req, comp, _t in abandoned:
+            comp._set(closed)
+        # admitted-but-unfinished sequences: the serve thread is down,
+        # so no further decode step will ever finish them
+        for i, act in enumerate(self._slots):
+            if act is None:
+                continue
+            self.pages.free(act.pages)
+            self._slots[i] = None
+            act.completion._set(closed)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

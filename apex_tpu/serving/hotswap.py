@@ -1,0 +1,154 @@
+"""Zero-downtime weight hot-swap — a manifest watcher on
+:class:`~apex_tpu.checkpoint.CheckpointManager` directories.
+
+A serving fleet cannot drain to pick up a newly trained checkpoint: the
+training job publishes ``step_*/`` directories (per-shard npz + JSON
+manifests, committed by the manifest rename), and the serving side must
+adopt each new one WITHOUT failing in-flight requests.  The watcher
+splits that into the two halves with different costs:
+
+* **staging** (slow, background): a poll thread watches the directory
+  with :func:`~apex_tpu.checkpoint.latest_checkpoint` — which already
+  skips mid-write ``.tmp`` debris, truncated shards, and
+  missing-manifest-part checkpoints, so an in-flight training save is
+  invisible until its manifests commit — and loads the newest VALID
+  step against the serving template (``load_checkpoint_dir`` device-puts
+  every leaf onto the template's committed shardings, so the staged
+  tree is already resident where the decode executables expect it);
+* **swap** (cheap, on the serving loop): :meth:`WeightWatcher.take`
+  hands the staged tree over between decode steps — one Python
+  reference assignment, zero dispatch cost, so the swap window is the
+  gap between two decode dispatches and no request ever observes a
+  half-updated tree.
+
+Validation is the checkpoint engine's own: a corrupt or in-flight
+checkpoint is never adopted, and a newer-but-invalid step falls back to
+the previous valid one (tested against the test_checkpoint debris
+fixtures — ISSUE 11 satellite).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from .. import telemetry as _telemetry
+from ..checkpoint import latest_checkpoint, load_checkpoint_dir
+
+__all__ = ["WeightWatcher"]
+
+
+class WeightWatcher:
+    """Watch a checkpoint directory and stage new weights for hot-swap.
+
+    ``like`` is the params-template pytree (shapes/dtypes/shardings the
+    serving engine runs with); ``extract`` maps a
+    :class:`~apex_tpu.checkpoint.Restored` to the params tree when the
+    checkpoint stores more than bare params (e.g. a training
+    ``TrainState`` — pass ``lambda r: r.state.params``).  Default:
+    ``r.state`` (the checkpoint IS the params tree).
+
+    Use :meth:`poll_once` for synchronous control (tests, the engine's
+    own cadence) or :meth:`start` for the background poll thread; either
+    way :meth:`take` returns a freshly staged ``(step, params)`` at most
+    once per adopted checkpoint.  Load failures of an individual
+    checkpoint are recorded (``last_error``) and retried on the next
+    poll — a torn checkpoint must never take the serving loop down.
+    """
+
+    def __init__(self, directory: str, like, *,
+                 extract: Optional[Callable] = None,
+                 poll_every_s: float = 1.0,
+                 initial_step: Optional[int] = None, telemetry=None):
+        self.directory = directory
+        self._like = like
+        self._extract = extract or (lambda restored: restored.state)
+        self.poll_every_s = float(poll_every_s)
+        self._telemetry = telemetry
+        self._lock = threading.Lock()
+        self._staged: Optional[Tuple[int, Any]] = None
+        #: step of the newest checkpoint staged or taken so far.  A
+        #: deployment that LOADED its starting weights from this same
+        #: directory passes ``initial_step=restored.step`` so the
+        #: watcher doesn't spuriously re-stage them as a "new" swap.
+        self.adopted_step: Optional[int] = initial_step
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _rec(self):
+        return (self._telemetry if self._telemetry is not None
+                else _telemetry.get_recorder())
+
+    # -- staging ------------------------------------------------------------
+    def poll_once(self) -> bool:
+        """Check the directory once; stage the newest VALID checkpoint
+        when it is newer than anything adopted so far.  Returns True
+        when something fresh was staged."""
+        import os
+        import re
+        found = latest_checkpoint(self.directory)
+        if found is None:
+            return False
+        m = re.match(r"^step_(\d+)$", os.path.basename(found))
+        step = int(m.group(1)) if m else -1
+        if self.adopted_step is not None and step <= self.adopted_step:
+            return False
+        t0 = time.perf_counter()
+        try:
+            restored = load_checkpoint_dir(found, self._like)
+            params = self._extract(restored)
+        except Exception as e:          # stage failures retry next poll
+            self.last_error = f"{type(e).__name__}: {e}"
+            rec = self._rec()
+            if rec is not None:
+                rec.event("serving", phase="stage_error", step=step,
+                          error=self.last_error)
+            return False
+        with self._lock:
+            self._staged = (step, params)
+            self.adopted_step = step
+        rec = self._rec()
+        if rec is not None:
+            rec.event("serving", phase="stage", step=step,
+                      dur=round(time.perf_counter() - t0, 6))
+        return True
+
+    def take(self) -> Optional[Tuple[int, Any]]:
+        """The staged ``(step, params)``, at most once per staged
+        checkpoint — the serving loop's swap point."""
+        with self._lock:
+            staged, self._staged = self._staged, None
+        return staged
+
+    # -- background poll thread ---------------------------------------------
+    def start(self) -> "WeightWatcher":
+        """Start the background poll thread (idempotent)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="apex-tpu-weight-watcher")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:     # pragma: no cover - defensive
+                self.last_error = f"{type(e).__name__}: {e}"
+            self._stop.wait(self.poll_every_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "WeightWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

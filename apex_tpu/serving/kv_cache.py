@@ -1,0 +1,156 @@
+"""Block-paged KV cache — the serving engine's memory substrate.
+
+Training owns one contiguous activation workspace per step; serving owns
+a POOL: sequences of wildly different lengths arrive and finish at
+arbitrary times, and a per-sequence ``[max_len]`` dense cache would
+strand most of its HBM in padding (a 2k-token model serving 50-token
+chats wastes 97%).  The standard answer (vLLM's PagedAttention) is to
+page the cache: a global pool of fixed-size token pages, per-sequence
+page tables, allocation at page granularity — admission never fragments
+and occupancy tracks REAL tokens, not padding.
+
+This module is that substrate, shaped for the XLA/TPU constraints of
+this codebase:
+
+* the **pool** is two device arrays ``[n_layers, n_pages, page_size,
+  n_kv_heads, head_dim]`` (k and v), donated through every serving step
+  so updates reuse the same HBM;
+* the **page table** is host state (:class:`PageAllocator`): a free
+  list plus per-sequence page lists.  Page id 0 is RESERVED as the
+  trash page — dead batch slots and the padded tail of short sequences
+  point there, so a masked lane can never corrupt a live page;
+* :func:`gather_views` / :func:`scatter_prefill` /
+  :func:`scatter_token` are the pure jit-safe bridges between the pool
+  and the dense ``[S, bucket, n_kv, head_dim]`` views
+  ``apex_tpu.models.gpt``'s incremental forward consumes.  The gather
+  reads each attended page exactly once — the same bytes attention
+  itself must stream, so paging adds page-table indexing, not a second
+  pass over HBM.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PageAllocator", "make_pool", "gather_views",
+           "scatter_prefill", "scatter_token"]
+
+#: page id 0 is the trash page: dead slots and table padding point at it.
+TRASH_PAGE = 0
+
+
+def make_pool(model, n_pages: int, page_size: int, dtype=None
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Zeroed ``(pool_k, pool_v)`` device arrays
+    ``[n_layers, n_pages, page_size, n_kv_heads, head_dim]`` for a
+    :class:`~apex_tpu.models.gpt.GPT` config.  GQA models pool only the
+    kv heads (the cache-bandwidth saving is real at decode, which is
+    bandwidth-bound)."""
+    n_kv = model.num_kv_heads or model.num_heads
+    head_dim = model.hidden_size // model.num_heads
+    dt = model.dtype if dtype is None else dtype
+    shape = (model.num_layers, n_pages, page_size, n_kv, head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def gather_views(pool_k, pool_v, tables):
+    """Dense per-layer cache views from the page pool.
+
+    ``tables``: ``[S, n_pages_b]`` int32 page ids (a bucket-width slice
+    of the host page table).  Returns a list of per-layer ``(k, v)``
+    pairs, each ``[S, n_pages_b * page_size, n_kv, head_dim]`` — exactly
+    the ``kv_caches`` shape the GPT incremental forward takes."""
+    n_layers, _, page_size, n_kv, head_dim = pool_k.shape
+    s, n_pages_b = tables.shape
+
+    def dense(pool):
+        g = pool[:, tables]          # [L, S, n_pages_b, page, n_kv, hd]
+        return g.reshape(n_layers, s, n_pages_b * page_size, n_kv,
+                         head_dim)
+
+    kd, vd = dense(pool_k), dense(pool_v)
+    return [(kd[i], vd[i]) for i in range(n_layers)]
+
+
+def scatter_prefill(pool, pages, dense):
+    """Write one sequence's prefilled cache back into its pages.
+
+    ``pages``: ``[n_pages_b]`` int32; ``dense``: ``[n_layers, bucket,
+    n_kv, head_dim]`` (the batch-1 view the prefill forward produced).
+    Page-granular scatter: one ``.at[].set`` over the page axis."""
+    n_layers, _, page_size, n_kv, head_dim = pool.shape
+    paged = dense.reshape(n_layers, pages.shape[0], page_size, n_kv,
+                          head_dim)
+    return pool.at[:, pages].set(paged.astype(pool.dtype))
+
+
+def scatter_token(pool, page_ids, offsets, tok):
+    """Write one fresh token's k or v per batch slot.
+
+    ``page_ids``/``offsets``: ``[S]`` int32 (page and in-page offset of
+    each slot's current position — dead slots point at the trash page);
+    ``tok``: ``[n_layers, S, n_kv, head_dim]``."""
+    return pool.at[:, page_ids, offsets].set(tok.astype(pool.dtype))
+
+
+class PageAllocator:
+    """Host-side page accounting: a free list over ``n_pages - 1`` real
+    pages (page 0 is the trash page and never allocated).  Thread-safe;
+    :meth:`alloc` is all-or-nothing so a request can never be admitted
+    half-resident."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(f"need >= 2 pages (one is the trash page), "
+                             f"got {n_pages}")
+        self.n_pages = int(n_pages)
+        self._free = list(range(n_pages - 1, TRASH_PAGE, -1))
+        self._lock = threading.Lock()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def total_pages(self) -> int:
+        """Allocatable pages (the trash page excluded)."""
+        return self.n_pages - 1
+
+    @property
+    def occupancy_pct(self) -> float:
+        """Percent of allocatable pages currently held by sequences —
+        the ``serving_kv_page_occupancy_pct`` gauge."""
+        total = self.total_pages
+        return 100.0 * (total - len(self._free)) / total if total else 0.0
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None when fewer are free (all-or-nothing)."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p == TRASH_PAGE:
+                    raise ValueError("attempted to free the trash page")
+                if p in self._free:
+                    raise ValueError(f"double free of page {p}")
+                self._free.append(p)
+
+    def padded_row(self, pages: Sequence[int], width: int) -> np.ndarray:
+        """One page-table row padded to ``width`` with the trash page.
+        A sequence holding MORE pages than the view is truncated: a
+        long-bucket sequence still early in its life decodes through a
+        smaller bucket's table, whose view covers exactly the first
+        ``width`` pages (its live positions all fit there)."""
+        row = np.full((width,), TRASH_PAGE, np.int32)
+        n = min(len(pages), width)
+        row[:n] = np.asarray(pages[:n], np.int32)
+        return row

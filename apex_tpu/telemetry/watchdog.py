@@ -50,6 +50,10 @@ Rules (each a small stateful fold; thresholds are constructor kwargs):
                           live device-memory read) reports free HBM below
                           ``min_headroom_pct`` of the device limit — the
                           pre-OOM warning, fired while the run still lives
+``serving_queue_stall``   a ``serving`` admit event's queue wait exceeded
+                          ``serving_stall_s`` — requests are aging in the
+                          queue faster than decode slots/KV pages free up
+                          (ISSUE 11: the inference twin of loader_stall)
 ========================  =====================================================
 
 Usage — the examples' ``--watchdog`` flag does exactly this::
@@ -72,7 +76,7 @@ __all__ = ["Watchdog", "attach", "RULE_NAMES"]
 
 RULE_NAMES = ("nonfinite", "scale_collapse", "loader_stall", "step_time",
               "retrace_storm", "checkpoint_stall", "checkpoint_failed",
-              "memory_headroom")
+              "memory_headroom", "serving_queue_stall")
 
 
 class _Rule:
@@ -355,6 +359,33 @@ class _MemoryHeadroom(_Rule):
         return None
 
 
+class _ServingQueueStall(_Rule):
+    """Request latency under load is queue wait + prefill + decode, and
+    queue wait is the term that explodes when traffic outruns capacity
+    (no free decode slots or KV pages).  The serving engine stamps every
+    admission with the request's measured queue wait; this rule fires
+    when one exceeds ``serving_stall_s`` — the "scale out or shed load"
+    signal, debounced like the rest (ISSUE 11)."""
+
+    name = "serving_queue_stall"
+
+    def __init__(self, serving_stall_s: float = 2.0):
+        self.serving_stall_s = float(serving_stall_s)
+
+    def observe(self, event):
+        if event.get("kind") != "serving" \
+                or event.get("phase") != "admit":
+            return None
+        wait = float(event.get("queue_wait", 0.0) or 0.0)
+        if wait <= self.serving_stall_s:
+            return None
+        return {"step": None, "value": round(wait, 3),
+                "message": f"request waited {wait:.2f}s in the serving "
+                           f"queue (> {self.serving_stall_s:.1f}s) — "
+                           f"traffic is outrunning decode slots/KV "
+                           f"pages; add capacity or shed load"}
+
+
 class Watchdog:
     """Folds recorder events through the rule set and emits debounced
     ``alert`` events back into the same stream.
@@ -390,6 +421,9 @@ class Watchdog:
                 _MemoryHeadroom(
                     min_headroom_pct=thresholds.get(
                         "min_headroom_pct", 10.0)),
+                _ServingQueueStall(
+                    serving_stall_s=thresholds.get(
+                        "serving_stall_s", 2.0)),
             ]
         self.rules = rules
         self.alerts: List[Dict[str, Any]] = []

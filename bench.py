@@ -1351,6 +1351,120 @@ def _bench_checkpoint():
     }
 
 
+def _bench_serving():
+    """ISSUE 11 self-validation: a closed-loop load generator against
+    the serving engine — submit a burst of mixed-length requests, drive
+    the scheduler to completion, and prove the acceptance contracts:
+
+    * **zero compiles after warmup across ALL sequence-length buckets**
+      — the engine's jit callables are trace-count pinned at 0 for the
+      whole load (every dispatch went through the AOT table) and no
+      lookup ever missed;
+    * **no failed requests**, including through a MID-LOAD weight
+      hot-swap: a new checkpoint published while requests are in
+      flight is staged and adopted between decode steps;
+    * **post-swap decode output matches the new checkpoint's
+      single-request output bitwise** (greedy decode is deterministic,
+      so "the swap really took and really serves the new weights" is
+      an equality, not a tolerance);
+    * throughput (**tokens/sec**) and **p50/p99 request
+      latency-under-load** are measured and recorded in
+      BENCH_EXTRA/BENCH_SUMMARY.
+
+    Runs on CPU and TPU alike — the contracts are backend-independent
+    (absolute rates are only meaningful on chip)."""
+    import shutil
+    import tempfile
+
+    from apex_tpu import serving
+    from apex_tpu.checkpoint import CheckpointManager
+    from apex_tpu.models import gpt_tiny
+    from apex_tpu.prof import assert_trace_count
+
+    model = gpt_tiny(max_len=128)
+    rs = np.random.RandomState(0)
+    probe = jnp.asarray(rs.randint(1, 1024, (1, 8)))
+    params = model.init(jax.random.PRNGKey(1), probe)["params"]
+    params_v2 = jax.tree_util.tree_map(lambda x: x * 1.01, params)
+
+    buckets, page, max_seqs, max_new = (32, 64), 8, 4, 8
+    n_requests = 12
+    prompts = [rs.randint(1, 1024, (int(n),)).astype(np.int32)
+               for n in rs.randint(4, 48, n_requests)]
+    ckpt_dir = tempfile.mkdtemp(prefix="apex_tpu_bench_serving_")
+    eng = serving.ServingEngine(model, params, buckets=buckets,
+                                page_size=page, max_seqs=max_seqs,
+                                watch_dir=ckpt_dir, poll_every_s=3600)
+    try:
+        t0 = time.perf_counter()
+        eng.warmup()
+        warmup_s = time.perf_counter() - t0
+        pins = [assert_trace_count(fn, 0) for fn in eng._jit.values()]
+        for p in pins:
+            p.__enter__()
+        try:
+            # phase 1: half the load on the v1 weights
+            comps = [eng.submit(p, max_new) for p in prompts[:6]]
+            for _ in range(8):
+                eng.step()
+            # phase 2: publish v2 MID-LOAD; stage + adopt between steps
+            mgr = CheckpointManager(ckpt_dir, keep=2, procs=(0, 1),
+                                    async_write=False)
+            mgr.save(11, params_v2)
+            mgr.close()
+            staged = eng.watcher.poll_once()
+            comps += [eng.submit(p, max_new) for p in prompts[6:]]
+            eng.run_until_idle()
+            wall = time.perf_counter() - t0 - warmup_s
+            results = [c.result(timeout=0) for c in comps]
+        finally:
+            for p in pins:
+                p.__exit__(None, None, None)
+        failed = [r for r in results if not r.ok]
+        lat = sorted(r.timings["total_s"] for r in results if r.ok)
+        tokens = int(eng.stats["tokens_out"])
+        # post-swap probe: bitwise vs a fresh engine on the v2 weights
+        post = eng.generate([prompts[0]], max_new_tokens=max_new)[0]
+        ref_eng = serving.ServingEngine(model, params_v2,
+                                        buckets=buckets, page_size=page,
+                                        max_seqs=max_seqs)
+        ref_eng.warmup(buckets=(post.bucket,))
+        ref = ref_eng.generate([prompts[0]], max_new_tokens=max_new)[0]
+        ref_eng.close()
+        hotswap_ok = (staged and eng.stats["hotswaps"] == 1
+                      and np.array_equal(post.tokens, ref.tokens))
+        misses = int(eng.stats["aot_misses"])
+        return {
+            "n_requests": n_requests,
+            "buckets": list(buckets),
+            "max_seqs": max_seqs,
+            "tokens_out": tokens,
+            "tokens_per_s": round(tokens / wall, 2) if wall > 0 else None,
+            "warmup_s": round(warmup_s, 3),
+            "p50_latency_ms": round(
+                _pct(lat, 50.0) * 1e3, 2) if lat else None,
+            "p99_latency_ms": round(
+                _pct(lat, 99.0) * 1e3, 2) if lat else None,
+            "failed_requests": len(failed),
+            "aot_misses": misses,
+            "zero_compiles_after_warmup": misses == 0,
+            "hotswaps": eng.stats["hotswaps"],
+            "hotswap_ok": bool(hotswap_ok),
+            "decode_steps": eng.stats["decode_steps"],
+            "kv_pages_leaked": (
+                eng.pages.total_pages - eng.pages.free_pages),
+        }
+    finally:
+        eng.close()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    from apex_tpu.telemetry.metrics import nearest_rank_percentiles
+    return nearest_rank_percentiles(sorted_vals, (q,))[0]
+
+
 def _bench_examples(on_tpu):
     """Execute the flagship example entry points and distill their own
     printed metrics.  Gates: the run completed, every printed loss is
@@ -2099,6 +2213,38 @@ def main():
             f"ms/step) — serialize/fsync leaked back onto the train "
             f"loop; refusing to report.")
 
+    # Serving-engine self-validation (ISSUE 11), backend-independent:
+    # the closed-loop load generator's acceptance contracts — zero
+    # compiles after warmup across all buckets, no failed requests, and
+    # a mid-load hot-swap that really serves the new weights.
+    extra["serving"] = srv = _bench_serving()
+    if not srv["zero_compiles_after_warmup"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: serving paid "
+            f"{srv['aot_misses']} compile(s) after warmup — an AOT "
+            f"bucket key is drifting (signature/static-param mismatch) "
+            f"or a dispatch fell off the warmed table; steady-state "
+            f"serving must pay ZERO compiles; refusing to report.")
+    if srv["failed_requests"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: {srv['failed_requests']} serving "
+            f"request(s) failed under the closed-loop load (incl. the "
+            f"mid-load hot-swap window) — the scheduler dropped or "
+            f"errored requests; refusing to report.")
+    if not srv["hotswap_ok"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: mid-load weight hot-swap "
+            f"(hotswaps={srv['hotswaps']}) did not produce decode "
+            f"output bitwise-matching the new checkpoint's "
+            f"single-request output — the watcher staged stale/corrupt "
+            f"weights or the swap never took; refusing to report.")
+    if srv["kv_pages_leaked"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: {srv['kv_pages_leaked']} KV "
+            f"page(s) still held after the load drained — the scheduler "
+            f"leaks pages on eviction and a long-running server would "
+            f"strand its whole pool; refusing to report.")
+
     # Self-validation, same contract as the MFU gates above: a steady
     # rate far below the example's own best window means the hot loop is
     # stalling on dispatch/syncs again (the exact regression class the
@@ -2287,6 +2433,9 @@ def main():
                 "it_per_sec_best_window"),
             "dcgan_example_window_gap_pct": dc.get("window_gap_pct"),
             "dcgan_example_loader_stall_pct": dc.get("loader_stall_pct"),
+            "serving_tokens_per_s": extra["serving"].get("tokens_per_s"),
+            "serving_p99_latency_ms": (
+                extra["serving"].get("p99_latency_ms")),
             "telemetry_overhead_ratio": (
                 extra["telemetry"].get("overhead_ratio")),
             "telemetry_step_p50_ms": (

@@ -39,8 +39,11 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # observability layer (ISSUE 10): the merge/aligner and the Prometheus
 # renderer are pure host JSON/text, and the memory walk a static jaxpr
 # replay — every tier must produce identical attributions and
-# expositions.
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py -q"
+# expositions.  test_serving.py rides for the inference engine (ISSUE
+# 11): the paged cache, AOT bucket table, scheduler, and hot-swap are
+# host machinery over plain XLA programs, so every degradation tier
+# must serve bitwise-identical greedy tokens.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py tests/test_serving.py -q"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

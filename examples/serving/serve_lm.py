@@ -1,0 +1,108 @@
+"""Serve a GPT causal LM with the apex_tpu serving engine (ISSUE 11).
+
+No reference counterpart (apex is training-only); this is the
+deployment-shaped driver of ``apex_tpu.serving``: AOT-bucketed
+prefill/decode (zero steady-state compiles), continuous batching over
+the paged KV cache, optional weight hot-swap from a training job's
+checkpoint directory, and the live ``serving_*`` gauges through
+``--telemetry`` / ``--metrics-port``.
+
+    python serve_lm.py --requests 16 --max-new 16
+    python serve_lm.py --checkpoint-dir /ckpts --watch --telemetry s.jsonl
+    python serve_lm.py --requests 64 --buckets 128,256 --max-seqs 8
+"""
+
+import os as _os
+import sys as _sys
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), *[_os.pardir] * 2)))
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from apex_tpu import serving, telemetry
+from apex_tpu.checkpoint import load_checkpoint_dir
+from apex_tpu.models import gpt_tiny
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=8,
+                    help="closed-loop load: this many synthetic prompts")
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--buckets", default="64,128",
+                    help="comma-separated sequence-length buckets")
+    ap.add_argument("--max-seqs", type=int, default=4,
+                    help="decode batch width (concurrent sequences)")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="load initial weights from the newest valid "
+                         "checkpoint here (a raw params-tree save)")
+    ap.add_argument("--watch", action="store_true",
+                    help="keep watching --checkpoint-dir and hot-swap "
+                         "newly committed checkpoints with zero downtime")
+    ap.add_argument("--telemetry", default=None,
+                    help="JSONL stream path (env APEX_TPU_TELEMETRY)")
+    args = ap.parse_args()
+
+    rec = None
+    if args.telemetry or (_os.environ.get("APEX_TPU_TELEMETRY") or "").strip():
+        rec = telemetry.start(args.telemetry, watchdog=True,
+                              example="serve_lm")
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    model = gpt_tiny(max_len=max(buckets))
+    rng = np.random.RandomState(args.seed)
+    probe = rng.randint(1, 1024, (1, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.numpy.asarray(probe))["params"]
+    start_step = None
+    if args.checkpoint_dir:
+        restored = load_checkpoint_dir(args.checkpoint_dir, params)
+        params, start_step = restored.state, restored.step
+        print(f"loaded checkpoint step {restored.step} "
+              f"from {args.checkpoint_dir}")
+
+    eng = serving.ServingEngine(
+        model, params, buckets=buckets, page_size=args.page_size,
+        max_seqs=args.max_seqs,
+        watch_dir=args.checkpoint_dir if args.watch else None,
+        watch_from_step=start_step)
+    try:
+        t0 = time.perf_counter()
+        eng.warmup()
+        print(f"warmup: {len(buckets)} bucket(s) AOT-compiled in "
+              f"{time.perf_counter() - t0:.1f}s")
+        prompts = [rng.randint(1, 1024, (int(n),))
+                   for n in rng.randint(4, max(buckets) - args.max_new,
+                                        args.requests)]
+        t0 = time.perf_counter()
+        results = eng.generate(prompts, max_new_tokens=args.max_new)
+        wall = time.perf_counter() - t0
+        ok = [r for r in results if r.ok]
+        lats = sorted(r.timings["total_s"] for r in ok)
+        p99 = lats[min(len(lats) - 1, int(0.99 * (len(lats) - 1)))]
+        print(f"served {len(ok)}/{len(results)} requests, "
+              f"{eng.stats['tokens_out']} tokens in {wall:.2f}s "
+              f"({eng.stats['tokens_out'] / wall:.1f} tok/s), "
+              f"p99 latency {p99 * 1e3:.1f} ms, "
+              f"aot_misses {eng.stats['aot_misses']}, "
+              f"hotswaps {eng.stats['hotswaps']}")
+    finally:
+        eng.close()
+        if rec is not None:
+            rec.close()
+            if rec.watchdog is not None:
+                print("health:", rec.watchdog.format_line())
+
+
+if __name__ == "__main__":
+    main()

@@ -157,3 +157,58 @@ def test_warm_cache_plus_warmup_end_to_end(tmp_path, tx_pipe):
         state, _ = pipe.step_window(state, win)
     assert_trace_count(pipe.loop, 0)
     assert len(os.listdir(cache.cache_dir())) > 0
+
+
+# -- static bucket params in the AOT key (ISSUE 11 satellite) -----------------
+
+def test_signature_static_params_distinguish_buckets():
+    """Two calls with identical array signatures but different static
+    bucket params must key to DIFFERENT AOT entries."""
+    win = (jnp.zeros((2, 3), jnp.float32),)
+    s64 = cache.signature(win, static=(64,))
+    s128 = cache.signature(win, static=(128,))
+    assert s64 != s128
+    assert s64[:-1] == s128[:-1] == cache.signature(win)
+    # deterministic and order-sensitive; mixed types are legal keys
+    assert cache.signature(win, static=(64,)) == s64
+    assert cache.signature(win, static=("prefill", 64)) \
+        != cache.signature(win, static=(64, "prefill"))
+
+
+def test_static_bucket_aot_table_lookup_miss_falls_back():
+    """The per-bucket AOT-table contract the serving engine relies on:
+    warmed buckets dispatch through the compiled executable, an
+    un-warmed bucket is a clean lookup miss that the jit path serves
+    (one compile) with identical numerics."""
+    def step(x, n_mask):
+        # n_mask is a static python int riding the closure per bucket
+        return jnp.tanh(x) * (jnp.arange(x.shape[-1]) < n_mask)
+
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+    aot = {}
+    jits = {}
+
+    def run(bucket):
+        key = cache.signature((x,), static=(bucket,))
+        fn = aot.get(key)
+        if fn is None:                         # lookup miss -> jit path
+            jfn = jits.setdefault(
+                bucket, jax.jit(lambda x: step(x, bucket)))
+            return jfn(x), False
+        return fn(x), True
+
+    # warm bucket 4 only
+    jits[4] = jax.jit(lambda x: step(x, 4))
+    aot[cache.signature((x,), static=(4,))] = cache.warmup(jits[4], x)
+
+    out4, hit4 = run(4)
+    assert hit4
+    with assert_trace_count(jits[4], 0):       # AOT hit: zero jit traces
+        out4b, _ = run(4)
+    np.testing.assert_array_equal(np.asarray(out4), np.asarray(out4b))
+
+    out6, hit6 = run(6)                        # never warmed: clean miss
+    assert not hit6
+    np.testing.assert_allclose(
+        np.asarray(out6),
+        np.tanh(np.asarray(x)) * (np.arange(8) < 6), atol=1e-6)

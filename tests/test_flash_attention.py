@@ -445,3 +445,76 @@ def test_flash_dispatch_routes_to_jnp_numerics():
                                  block_q=128, block_k=128, interpret=True)
     np.testing.assert_allclose(np.asarray(out_default),
                                np.asarray(out_kernel), atol=2e-2, rtol=2e-2)
+
+
+# -- decode-shaped causal inputs (ISSUE 11 satellite) -------------------------
+
+def _suffix_causal_ref(q, k, v, key_padding_bias=None):
+    """Reference for decode-shaped causal attention: run the FULL causal
+    oracle over the whole sequence (queries = the last tq positions) and
+    slice the suffix rows — token-for-token what a KV-cache decode must
+    reproduce."""
+    tq, tk = q.shape[1], k.shape[1]
+    # embed the queries at their true (suffix) positions: pad with the
+    # keys' own projections so positions 0..tk-tq-1 exist, then slice.
+    bias = None
+    if key_padding_bias is not None:
+        bias = key_padding_bias[:, None, None, :]
+    qi = (tk - tq) + jnp.arange(tq)[:, None]
+    ki = jnp.arange(tk)[None, :]
+    causal = jnp.where(qi >= ki, 0.0, -1e30)[None, None]
+    bias = causal if bias is None else bias + causal
+    return dot_product_attention(q, k, v, causal=False, bias=bias)
+
+
+@pytest.mark.parametrize("tq", [1, 4, 7])
+def test_decode_shaped_causal_matches_reference(tq):
+    """causal with q_len < kv_len must suffix-align the queries (the
+    KV-cache decode convention) — before the fix a q_len=1 causal call
+    silently attended only key 0."""
+    B, TK, H, D = 2, 96, 2, 16
+    q = _rand((B, tq, H, D), 0)
+    k = _rand((B, TK, H, D), 1)
+    v = _rand((B, TK, H, D), 2)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _suffix_causal_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_shaped_causal_with_live_mask():
+    """One fresh token over a cache of TK slots with only the first L
+    live (key_padding_bias masks the dead tail) — the serving engine's
+    decode call shape."""
+    B, TK, H, D = 3, 64, 2, 16
+    live_len = jnp.array([5, 17, 64])
+    q = _rand((B, 1, H, D), 3)
+    k = _rand((B, TK, H, D), 4)
+    v = _rand((B, TK, H, D), 5)
+    kb = jnp.where(jnp.arange(TK)[None, :] < live_len[:, None], 0.0, -1e9)
+    out = flash_attention(q, k, v, causal=True, key_padding_bias=kb)
+    ref = _suffix_causal_ref(q, k, v, key_padding_bias=kb)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_shaped_causal_kernel_path_matches():
+    """A sublane-aligned short query block keeps the kernel path
+    (interpret mode) — suffix alignment must hold there too, not only on
+    the jnp fallback."""
+    B, TQ, TK, H, D = 1, 8, 128, 2, 16
+    q = _rand((B, TQ, H, D), 6)
+    k = _rand((B, TK, H, D), 7)
+    v = _rand((B, TK, H, D), 8)
+    out = flash_attention(q, k, v, causal=True, block_q=8, block_k=128,
+                          interpret=True)
+    ref = _suffix_causal_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_more_queries_than_keys_raises():
+    q = _rand((1, 8, 2, 16), 0)
+    k = _rand((1, 4, 2, 16), 1)
+    with pytest.raises(ValueError, match="q_len"):
+        flash_attention(q, k, q * 0, causal=True)

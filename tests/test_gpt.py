@@ -147,3 +147,114 @@ def test_generate_rejects_sp_models():
     m = gpt_tiny(sp_axis="sp", attention_impl="ring")
     with pytest.raises(ValueError, match="sp_axis"):
         generate(m, {}, jnp.zeros((1, 4), jnp.int32), 4)
+
+
+# -- external-cache incremental forward (ISSUE 11) ----------------------------
+
+@pytest.mark.parametrize("kw", [{}, {"num_kv_heads": 2}])
+def test_incremental_forward_matches_full_greedy(kw):
+    """The serving-engine forward: prefill once into an external dense
+    cache, then single-token decode steps with per-sequence positions —
+    must reproduce token-for-token the repeated-full-forward greedy
+    sequence (incl. GQA caches, which store only the kv heads)."""
+    from apex_tpu.models.gpt import init_cache
+
+    m = gpt_tiny(max_len=64, **kw)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(1, 1024, (2, 6)))
+    params = m.init(jax.random.PRNGKey(1), prompt)["params"]
+
+    ids = prompt
+    for _ in range(8):
+        logits = m.apply({"params": params}, ids)[:, -1]
+        ids = jnp.concatenate([ids, jnp.argmax(logits, -1)[:, None]],
+                              axis=1)
+    ref = np.asarray(ids)
+
+    caches = init_cache(m, 2, cache_len=64)
+    if kw.get("num_kv_heads"):      # GQA caches are kv-head shaped
+        assert caches[0][0].shape[2] == kw["num_kv_heads"]
+    logits, caches = m.apply({"params": params}, prompt,
+                             kv_caches=caches,
+                             positions=jnp.zeros((2,), jnp.int32))
+    tok = jnp.argmax(logits[:, -1], -1)
+    out = [np.asarray(tok)]
+    pos = jnp.full((2,), prompt.shape[1], jnp.int32)
+    for _ in range(7):
+        logits, caches = m.apply({"params": params}, tok[:, None],
+                                 kv_caches=caches, positions=pos)
+        tok = jnp.argmax(logits[:, -1], -1)
+        out.append(np.asarray(tok))
+        pos = pos + 1
+    inc = np.concatenate([np.asarray(prompt), np.stack(out, 1)], axis=1)
+    np.testing.assert_array_equal(ref, inc)
+
+
+def test_incremental_forward_staggered_positions():
+    """Continuous batching's defining shape: two sequences at DIFFERENT
+    positions in one decode batch.  Each row must match its own
+    single-sequence trajectory exactly — the flax-cache path cannot do
+    this (one scalar cache_index for the whole batch)."""
+    from apex_tpu.models.gpt import init_cache
+
+    m = gpt_tiny(max_len=32)
+    rng = np.random.RandomState(1)
+    pa = jnp.asarray(rng.randint(1, 1024, (1, 7)))
+    pb = jnp.asarray(rng.randint(1, 1024, (1, 3)))
+    params = m.init(jax.random.PRNGKey(2), pa)["params"]
+
+    def solo(prompt, n):
+        caches = init_cache(m, 1, cache_len=32)
+        logits, caches = m.apply(
+            {"params": params}, prompt, kv_caches=caches,
+            positions=jnp.zeros((1,), jnp.int32))
+        tok = jnp.argmax(logits[:, -1], -1)
+        toks, pos = [int(tok[0])], prompt.shape[1]
+        for _ in range(n - 1):
+            logits, caches = m.apply(
+                {"params": params}, tok[:, None], kv_caches=caches,
+                positions=jnp.full((1,), pos, jnp.int32))
+            tok = jnp.argmax(logits[:, -1], -1)
+            toks.append(int(tok[0]))
+            pos += 1
+        return toks
+
+    ref_a, ref_b = solo(pa, 4), solo(pb, 4)
+
+    # batched: prefill each row separately into rows of one 2-deep cache
+    caches = init_cache(m, 2, cache_len=32)
+
+    def prefill_row(row, prompt):
+        nonlocal caches
+        row_caches = [(k[row:row + 1], v[row:row + 1]) for k, v in caches]
+        logits, new = m.apply({"params": params}, prompt,
+                              kv_caches=row_caches,
+                              positions=jnp.zeros((1,), jnp.int32))
+        caches = [(k.at[row].set(nk[0]), v.at[row].set(nv[0]))
+                  for (k, v), (nk, nv) in zip(caches, new)]
+        return int(jnp.argmax(logits[0, -1]))
+
+    t_a = prefill_row(0, pa)
+    t_b = prefill_row(1, pb)
+    got_a, got_b = [t_a], [t_b]
+    pos = jnp.asarray([pa.shape[1], pb.shape[1]], jnp.int32)
+    tok = jnp.asarray([t_a, t_b])
+    for _ in range(3):
+        logits, caches = m.apply({"params": params}, tok[:, None],
+                                 kv_caches=caches, positions=pos)
+        tok = jnp.argmax(logits[:, -1], -1)
+        got_a.append(int(tok[0]))
+        got_b.append(int(tok[1]))
+        pos = pos + 1
+    assert got_a == ref_a and got_b == ref_b
+
+
+def test_init_cache_validates_len():
+    from apex_tpu.models.gpt import init_cache
+    m = gpt_tiny(max_len=16)
+    with pytest.raises(ValueError, match="max_len"):
+        init_cache(m, 1, cache_len=64)
+    c = init_cache(m, 3)
+    assert len(c) == m.num_layers
+    assert c[0][0].shape == (3, 16, m.num_heads,
+                             m.hidden_size // m.num_heads)

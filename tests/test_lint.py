@@ -124,7 +124,9 @@ def test_j001_zip_and_while_body_syncs_flag():
         v = x.item()
         break
     """
-    assert _codes(bad_while, "examples/demo.py") == ["J001"]
+    # a while-body sync still flags — since ISSUE 11 as the more
+    # specific serving-loop rule J012 (reported INSTEAD of J001)
+    assert _codes(bad_while, "examples/demo.py") == ["J012"]
 
 
 def test_j001_scalar_loop_counters_stay_host_values():
@@ -1049,3 +1051,102 @@ def test_repo_gate_actually_sees_the_package():
     n_pkg = len(glob.glob(os.path.join(REPO, "apex_tpu", "**", "*.py"),
                           recursive=True))
     assert n_pkg > 30        # the package has ~40 modules
+
+
+# -- J012: per-request host syncs in serving contexts (ISSUE 11) --------------
+
+def test_j012_sync_in_while_serving_loop():
+    bad = """
+    import jax
+
+    def drain(queue, engine):
+        while queue:
+            out = engine.decode()
+            jax.block_until_ready(out)
+    """
+    assert _codes(bad) == ["J012"]
+
+
+def test_j012_sync_in_request_handler_function():
+    bad = """
+    import jax
+
+    def handle_request(engine, prompt):
+        logits = engine.prefill(prompt)
+        return jax.device_get(logits)
+    """
+    assert _codes(bad) == ["J012"]
+    # handler-segment matching: 'serve'/'request'/'handler' names too
+    also = bad.replace("handle_request", "serve_one")
+    assert _codes(also) == ["J012"]
+
+
+def test_j012_replaces_j001_not_added_to_it():
+    """J012 is the MORE SPECIFIC rule: in a serving context the sync is
+    reported once as J012, never doubled with J001; outside those
+    contexts a loop sync stays plain J001."""
+    serving = """
+    import jax
+
+    def pump(engine):
+        while True:
+            x = engine.step()
+            v = float(jax.device_get(x))
+    """
+    assert _codes(serving) == ["J012"]
+    plain = """
+    import jax
+
+    def sweep(items):
+        for it in items:
+            jax.device_get(it)
+    """
+    assert _codes(plain) == ["J001"]
+
+
+def test_j012_waived_response_boundary():
+    ok = """
+    import numpy as np
+
+    def handle_request(engine, prompt):
+        tok = engine.decode(prompt)
+        return np.asarray(tok)  # jaxlint: disable=J001,J012 -- the response boundary: sampled tokens must reach the caller
+    """
+    assert _codes(ok) == []
+
+
+def test_j012_driver_top_level_handler_not_flagged():
+    """Driver scripts keep the in-loop gate: a handler-named function
+    syncing once at top level is the legitimate end-of-run read."""
+    src = """
+    import jax
+
+    def handle_request(engine, p):
+        return jax.device_get(engine.run(p))
+    """
+    assert _codes(src, path="examples/serve.py") == []
+    # ...but a while-loop sync in a driver is still per-request
+    loop = """
+    import jax
+
+    def main(engine, reqs):
+        while reqs:
+            jax.device_get(engine.step())
+    """
+    assert _codes(loop, path="examples/serve.py") == ["J012"]
+
+
+def test_j012_interior_on_segment_stays_j001():
+    """`on` marks a handler only as a PREFIX (`on_request`): an interior
+    `_on_` (train_on_batch) must stay J001 so existing J001 waivers keep
+    covering it."""
+    src = """
+    import jax
+
+    def train_on_batch(step, state, b):
+        state, m = step(state, b)
+        return float(jax.device_get(m))
+    """
+    assert _codes(src) == ["J001"]
+    prefixed = src.replace("train_on_batch", "on_request")
+    assert _codes(prefixed) == ["J012"]

@@ -1,0 +1,424 @@
+"""Serving engine (ISSUE 11): paged KV cache, AOT-bucketed
+prefill/decode, continuous batching, weight hot-swap, and per-request
+telemetry.
+
+The acceptance pins: every request's greedy output is BITWISE the
+repeated-full-forward sequence regardless of how the scheduler batched
+it (continuous batching is an optimization, never a numerics change);
+warmed buckets serve with ZERO jit traces; an un-warmed bucket is a
+clean lookup miss served by the jit path; a mid-load hot-swap fails no
+request and post-swap outputs match the new checkpoint's; the manifest
+watcher never adopts corrupt/in-flight checkpoints (the test_checkpoint
+debris fixtures, pointed at the watcher).
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.checkpoint import CheckpointManager, latest_checkpoint
+from apex_tpu.models import gpt_tiny
+from apex_tpu.prof import assert_trace_count
+from apex_tpu.serving.kv_cache import (PageAllocator, gather_views,
+                                       make_pool, scatter_prefill,
+                                       scatter_token)
+
+VOCAB = 256
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.set_recorder(None)
+    yield
+    telemetry.set_recorder(None)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    m = gpt_tiny(max_len=64, vocab_size=VOCAB, hidden_size=64,
+                 num_layers=2, num_heads=2, mlp_dim=128)
+    probe = jnp.asarray(np.random.RandomState(0).randint(1, VOCAB, (1, 8)))
+    params = m.init(jax.random.PRNGKey(1), probe)["params"]
+    return m, params
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, VOCAB, (n,)).astype(
+        np.int32)
+
+
+def _full_forward_greedy(m, params, prompt, n_new):
+    """The oracle: repeated full forward passes, argmax each step."""
+    ids = jnp.asarray(prompt)[None]
+    for _ in range(n_new):
+        logits = m.apply({"params": params}, ids)[:, -1]
+        ids = jnp.concatenate(
+            [ids, jnp.argmax(logits, -1)[:, None].astype(ids.dtype)],
+            axis=1)
+    return np.asarray(ids[0, len(prompt):])
+
+
+# -- paged KV cache substrate -------------------------------------------------
+
+def test_page_allocator_accounting():
+    al = PageAllocator(9)                # 8 allocatable + trash page 0
+    assert al.total_pages == 8 and al.free_pages == 8
+    a = al.alloc(3)
+    b = al.alloc(5)
+    assert al.free_pages == 0 and al.alloc(1) is None   # all-or-nothing
+    assert al.occupancy_pct == 100.0
+    assert 0 not in a + b                # trash page never allocated
+    al.free(a)
+    assert al.free_pages == 3 and al.occupancy_pct == pytest.approx(62.5)
+    with pytest.raises(ValueError, match="double free"):
+        al.free(b + b[:1])
+    with pytest.raises(ValueError, match="trash"):
+        al.free([0])
+
+
+def test_pool_gather_scatter_roundtrip(model_and_params):
+    """scatter_prefill -> gather_views must reproduce the dense cache
+    exactly, through an arbitrary page permutation."""
+    m, _ = model_and_params
+    page = 4
+    pool_k, pool_v = make_pool(m, n_pages=9, page_size=page)
+    bucket = 16
+    rng = np.random.RandomState(3)
+    dense = jnp.asarray(rng.randn(m.num_layers, bucket, 2,
+                                  pool_k.shape[-1]), pool_k.dtype)
+    pages = jnp.asarray([5, 2, 7, 1], jnp.int32)      # permuted pages
+    pool_k = scatter_prefill(pool_k, pages, dense)
+    tables = np.zeros((2, bucket // page), np.int32)
+    tables[1] = np.asarray(pages)                     # slot 1 owns them
+    views = gather_views(pool_k, pool_v, jnp.asarray(tables))
+    for i in range(m.num_layers):
+        np.testing.assert_array_equal(np.asarray(views[i][0][1]),
+                                      np.asarray(dense[i]))
+        assert not np.any(np.asarray(views[i][0][0]))  # slot 0: trash
+    # single-token scatter lands at (page, offset)
+    tok = jnp.ones((m.num_layers, 2, 2, pool_k.shape[-1]), pool_k.dtype)
+    pool_k = scatter_token(pool_k, jnp.asarray([5, 0]),
+                           jnp.asarray([3, 0]), tok)
+    np.testing.assert_array_equal(
+        np.asarray(pool_k[:, 5, 3]), np.ones_like(np.asarray(pool_k[:, 5, 3])))
+
+
+# -- engine: continuous batching parity ---------------------------------------
+
+def test_engine_matches_full_forward_greedy(model_and_params):
+    """Mixed prompt lengths across two buckets, more requests than
+    slots: every request's tokens are bitwise the full-forward greedy
+    sequence, pages drain to zero, and no AOT lookup ever missed."""
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16, 32), page_size=4,
+                                max_seqs=2)
+    eng.warmup()
+    prompts = [_prompt(n, seed=n) for n in (3, 7, 12, 5, 9)]
+    results = eng.generate(prompts, max_new_tokens=5)
+    for p, r in zip(prompts, results):
+        assert r.ok
+        np.testing.assert_array_equal(
+            _full_forward_greedy(m, params, p, 5), r.tokens)
+    assert eng.stats["completed"] == 5
+    assert eng.stats["aot_misses"] == 0
+    assert eng.pages.occupancy_pct == 0.0
+    assert {r.bucket for r in results} == {16, 32}   # both buckets hit
+    eng.close()
+
+
+def test_engine_zero_traces_after_warmup(model_and_params):
+    """The steady-state contract: after warmup, serving dispatches go
+    straight to the AOT executables — ZERO traces on the jit callables
+    (pinned), zero lookup misses."""
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2)
+    eng.warmup()
+    pins = [assert_trace_count(fn, 0) for fn in eng._jit.values()]
+    for pin in pins:
+        pin.__enter__()
+    try:
+        eng.generate([_prompt(4), _prompt(6, 1)], max_new_tokens=4)
+    finally:
+        for pin in pins:
+            pin.__exit__(None, None, None)
+    assert eng.stats["aot_misses"] == 0
+    eng.close()
+
+
+def test_unwarmed_bucket_is_clean_lookup_miss(model_and_params):
+    """ISSUE 11 satellite: a bucket never warmed keys to a MISS in the
+    AOT table (the static bucket param keeps keys distinct) and the jit
+    fallback serves it with identical numerics."""
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16, 32), page_size=4,
+                                max_seqs=2)
+    eng.warmup(buckets=(16,))            # bucket 32 never warmed
+    p_small, p_big = _prompt(4), _prompt(20, 1)
+    r_small, r_big = eng.generate([p_small, p_big], max_new_tokens=4)
+    assert r_small.bucket == 16 and r_big.bucket == 32
+    assert eng.stats["aot_misses"] > 0   # the miss was counted...
+    np.testing.assert_array_equal(      # ...and served correctly
+        _full_forward_greedy(m, params, p_big, 4), r_big.tokens)
+    np.testing.assert_array_equal(
+        _full_forward_greedy(m, params, p_small, 4), r_small.tokens)
+    eng.close()
+
+
+def test_admission_waits_for_free_pages(model_and_params):
+    """More concurrent demand than pages: requests queue until an
+    eviction frees pages — nothing is dropped, everything completes."""
+    m, params = model_and_params
+    # pool sized for ONE bucket-16 sequence (4 pages + trash)
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2, n_pages=5)
+    eng.warmup()
+    prompts = [_prompt(4, s) for s in range(3)]
+    results = eng.generate(prompts, max_new_tokens=3)
+    assert all(r.ok for r in results)
+    for p, r in zip(prompts, results):
+        np.testing.assert_array_equal(
+            _full_forward_greedy(m, params, p, 3), r.tokens)
+    eng.close()
+
+
+def test_oversized_request_rejected_not_truncated(model_and_params):
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=1)
+    eng.warmup()
+    r = eng.generate([_prompt(14)], max_new_tokens=8)[0]
+    assert not r.ok and "fits no bucket" in r.error
+    assert eng.stats["rejected"] == 1
+    assert eng.pages.occupancy_pct == 0.0
+    eng.close()
+
+
+def test_stop_token_finishes_early(model_and_params):
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(32,), page_size=4,
+                                max_seqs=1)
+    eng.warmup()
+    p = _prompt(5, 7)
+    free_run = eng.generate([p], max_new_tokens=10)[0]
+    toks = free_run.tokens.tolist()
+    # stop on the first token whose FIRST occurrence is past index 0
+    i, stop = next((i, t) for i, t in enumerate(toks)
+                   if i >= 1 and t not in toks[:i])
+    stopped = eng.generate([p], max_new_tokens=10, stop_token=stop)[0]
+    assert stopped.tokens.tolist() == toks[:i + 1]
+    eng.close()
+
+
+def test_submit_backpressure_and_threaded_serving(model_and_params):
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=1, max_queue=2)
+    eng.warmup()
+    c1 = eng.submit(_prompt(3), 2)
+    c2 = eng.submit(_prompt(3, 1), 2)
+    with pytest.raises(RuntimeError, match="queue full"):
+        eng.submit(_prompt(3, 2), 2, block=False)
+    eng.start()                          # serve thread drains the queue
+    assert c1.result(timeout=60).ok and c2.result(timeout=60).ok
+    eng.close()
+
+
+# -- weight hot-swap ----------------------------------------------------------
+
+def _save_params(directory, params, step):
+    mgr = CheckpointManager(directory, keep=3, procs=(0, 1),
+                            async_write=False)
+    mgr.save(step, params)
+    mgr.close()
+
+
+def test_hotswap_mid_load_no_failed_requests(model_and_params, tmp_path):
+    """The zero-downtime contract: a checkpoint published mid-load is
+    adopted between steps; every in-flight request completes; requests
+    served AFTER the swap match the new checkpoint's single-request
+    output bitwise."""
+    m, params = model_and_params
+    params2 = jax.tree_util.tree_map(lambda x: x * 1.01, params)
+    d = str(tmp_path / "ckpt")
+    eng = serving.ServingEngine(m, params, buckets=(32,), page_size=4,
+                                max_seqs=2, watch_dir=d, poll_every_s=60)
+    eng.warmup()
+    # in-flight request, half served under the old weights
+    comp = eng.submit(_prompt(5), 8)
+    for _ in range(4):
+        eng.step()
+    _save_params(d, params2, step=11)
+    assert eng.watcher.poll_once()       # stage synchronously (no sleep)
+    eng.run_until_idle()
+    assert comp.result(timeout=0).ok     # in-flight request completed
+    assert eng.stats["hotswaps"] == 1
+
+    post = eng.generate([_prompt(6, 9)], max_new_tokens=5)[0]
+    assert post.ok and eng.stats["aot_misses"] == 0
+    np.testing.assert_array_equal(       # bitwise vs the new checkpoint
+        _full_forward_greedy(m, params2, _prompt(6, 9), 5), post.tokens)
+    eng.close()
+
+
+def test_watcher_ignores_corrupt_and_inflight_manifests(model_and_params,
+                                                        tmp_path):
+    """ISSUE 11 satellite (the test_checkpoint debris fixtures, pointed
+    at the watcher): a truncated shard + .tmp debris, a bit-flipped
+    shard, and a missing manifest part must all be invisible — the
+    watcher stays on the newest VALID step and adopts a later valid one
+    when it commits."""
+    m, params = model_and_params
+    d = str(tmp_path / "ckpt")
+    w = serving.WeightWatcher(d, like=params, poll_every_s=60)
+    assert not w.poll_once()             # empty directory: nothing
+    _save_params(d, params, step=5)
+    assert w.poll_once() and w.adopted_step == 5
+    assert w.take()[0] == 5 and w.take() is None    # at most once
+
+    # newest = torn write: truncated shard + .tmp debris
+    params2 = jax.tree_util.tree_map(lambda x: x * 2.0, params)
+    _save_params(d, params2, step=10)
+    newest = latest_checkpoint(d)
+    shard = glob.glob(os.path.join(newest, "shard_*.npz"))[0]
+    with open(shard, "r+b") as f:
+        f.truncate(16)
+    with open(shard + ".tmp", "wb") as f:
+        f.write(b"partial")
+    assert not w.poll_once() and w.adopted_step == 5
+
+    # newest = bit corruption (checksum catches it)
+    _save_params(d, params2, step=15)
+    shard = glob.glob(os.path.join(
+        d, "step_00000015", "shard_*.npz"))[0]
+    data = bytearray(open(shard, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(data))
+    assert not w.poll_once() and w.adopted_step == 5
+
+    # newest = in-flight multi-host save (one manifest part missing)
+    m0 = CheckpointManager(d, procs=(0, 2), async_write=False)
+    m0.save(20, params2)
+    m0.close()
+    assert not w.poll_once() and w.adopted_step == 5
+
+    # a later VALID checkpoint is adopted over all the debris
+    _save_params(d, params2, step=25)
+    assert w.poll_once() and w.take()[0] == 25
+    w.close()
+
+
+# -- telemetry ----------------------------------------------------------------
+
+def test_serving_events_and_gauges_in_stream(model_and_params, tmp_path):
+    m, params = model_and_params
+    path = str(tmp_path / "serve.jsonl")
+    rec = telemetry.start(path, watchdog=True, example="serving-test")
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2)
+    eng.warmup()
+    eng.generate([_prompt(4), _prompt(6, 1)], max_new_tokens=3)
+    snap = rec.metrics.snapshot()
+    eng.close()
+    rec.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    phases = [e.get("phase") for e in events if e["kind"] == "serving"]
+    for want in ("submit", "admit", "decode", "done"):
+        assert want in phases, f"missing serving phase {want}"
+    admit = next(e for e in events
+                 if e["kind"] == "serving" and e["phase"] == "admit")
+    assert "queue_wait" in admit and "prefill_dur" in admit
+    done = next(e for e in events
+                if e["kind"] == "serving" and e["phase"] == "done")
+    assert done["n_tokens"] == 3 and "decode_s" in done
+    gauges = snap["gauges"]
+    for g in ("serving_queue_depth", "serving_active_seqs",
+              "serving_kv_page_occupancy_pct"):
+        assert g in gauges, f"missing gauge {g}"
+    hists = snap["histograms"]
+    for h in ("serving_queue_wait_s", "serving_prefill_s",
+              "serving_decode_step_s"):
+        assert h in hists and hists[h]["count"] > 0
+    # the clean run raised no serving alerts
+    assert not any(e["kind"] == "alert" for e in events)
+
+
+def test_serving_queue_stall_alert_end_to_end(model_and_params, tmp_path):
+    """A request that waits past the threshold in the queue trips the
+    serving_queue_stall rule when it is finally admitted."""
+    m, params = model_and_params
+    from apex_tpu.telemetry import watchdog as wdog
+    path = str(tmp_path / "stall.jsonl")
+    rec = telemetry.Recorder(path)
+    wdog.attach(rec, serving_stall_s=0.0)
+    telemetry.set_recorder(rec)
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=1)
+    eng.warmup()
+    eng.generate([_prompt(4), _prompt(5, 1)], max_new_tokens=2)
+    eng.close()
+    rec.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    alerts = [e for e in events if e["kind"] == "alert"]
+    assert any(a["rule"] == "serving_queue_stall" for a in alerts)
+
+
+# -- example smoke ------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_lm_example_smoke():
+    """The deployment driver runs end to end and prints the served
+    line (subprocess: the example owns its own recorder/engine)."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "serving",
+                                      "serve_lm.py"),
+         "--requests", "3", "--max-new", "3", "--buckets", "32",
+         "--page-size", "8"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "served 3/3 requests" in r.stdout
+    assert "aot_misses 0" in r.stdout
+
+
+def test_close_resolves_inflight_and_queued_requests(model_and_params):
+    """close() must fail BOTH never-admitted and admitted-but-unfinished
+    requests (no caller blocks forever) and return their pages to the
+    pool; submit after close raises (review findings)."""
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=1)
+    eng.warmup()
+    inflight = eng.submit(_prompt(3), 8)
+    eng.step()                           # admitted, far from finished
+    queued = eng.submit(_prompt(4, 1), 8)
+    eng.close()
+    assert not inflight.result(timeout=5).ok
+    assert not queued.result(timeout=5).ok
+    assert eng.pages.occupancy_pct == 0.0
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(_prompt(3), 2)
+
+
+def test_run_until_idle_refuses_beside_serve_thread(model_and_params):
+    m, params = model_and_params
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=1)
+    eng.warmup()
+    eng.start()
+    with pytest.raises(RuntimeError, match="serve thread"):
+        eng.run_until_idle()
+    # generate() beside the serve thread submits + waits instead
+    r = eng.generate([_prompt(4)], max_new_tokens=2)[0]
+    assert r.ok
+    eng.close()

@@ -370,3 +370,26 @@ def test_nan_loss_through_pipeline_alerts(tmp_path):
     rec.close()
     nonfin = [a for a in wd.alerts if a["rule"] == "nonfinite"]
     assert nonfin and nonfin[0]["step"] == 6
+
+
+def test_serving_queue_stall_rule(tmp_path):
+    """ISSUE 11: an admit event whose queue wait exceeds the threshold
+    alerts; fast admissions and non-admit serving events stay silent;
+    debounce bounds repeats."""
+    rec, wd = _recorder(tmp_path, serving_stall_s=0.5)
+    rec.event("serving", phase="submit", queue_depth=3)
+    rec.event("serving", phase="admit", slot=0, queue_wait=0.1)   # fast
+    rec.event("serving", phase="decode", active=1, dur=0.01)
+    rec.event("serving", phase="admit", slot=1, queue_wait=1.7)   # stall
+    for _ in range(5):                              # debounced repeats
+        rec.event("serving", phase="admit", slot=2, queue_wait=2.0)
+    alerts = _alerts(rec)
+    assert [a["rule"] for a in alerts] == ["serving_queue_stall"]
+    assert alerts[0]["severity"] == "warning"
+    assert alerts[0]["value"] == 1.7
+
+
+def test_serving_queue_stall_threshold_kwarg(tmp_path):
+    rec, wd = _recorder(tmp_path, serving_stall_s=10.0)
+    rec.event("serving", phase="admit", queue_wait=3.0)
+    assert _alerts(rec) == []

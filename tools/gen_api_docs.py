@@ -57,6 +57,8 @@ PAGES = {
     "contrib": ["apex_tpu.contrib.xentropy", "apex_tpu.contrib.groupbn"],
     "models": ["apex_tpu.models"],
     "checkpoint_data": ["apex_tpu.checkpoint", "apex_tpu.data"],
+    "serving": ["apex_tpu.serving", "apex_tpu.serving.engine",
+                "apex_tpu.serving.kv_cache", "apex_tpu.serving.hotswap"],
 }
 
 

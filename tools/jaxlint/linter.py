@@ -1,6 +1,6 @@
 """jaxlint core — AST rules, waiver handling, and the lint engine.
 
-Ten rules tuned to this codebase's failure modes (the ones that are
+Rules J001–J012 tuned to this codebase's failure modes (the ones that are
 invisible to pytest and surface as 10x dispatch-floor regressions in
 ``bench.py``):
 
@@ -66,6 +66,17 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   (``apex_tpu.prof.roofline.harvest_costs``) and reuse the result
   (ISSUE 6: the static twin of the roofline engine's harvest-at-trace-
   time contract).
+* **J012** per-request host syncs in serving contexts: a J001-class
+  sync (``device_get``/``.item()``/``block_until_ready``/``float()`` on
+  an array) inside a ``while`` loop or inside a request-handler
+  function (``handle*``/``serve*``/``on_*``/``*_handler``/
+  ``*request*``).  A training loop pays one sync per K-step window; a
+  serving loop that syncs PER REQUEST (or per decode step) caps
+  throughput at a host round-trip per token — defer the fetch one step
+  behind (the ``DeferredMetrics`` pattern) or batch it, and waive ONLY
+  the sanctioned response boundary, where sampled tokens must reach the
+  host to drive termination/eviction (ISSUE 11: the serving twin of
+  the J001/J008 stalls).  Reported INSTEAD of J001 in those contexts.
 * **J011** (advisory) unfused BN/GN + ReLU chains in model bodies:
   ``nn.BatchNorm``/``nn.GroupNorm`` applied and immediately followed by
   ``nn.relu`` — nested (``nn.relu(nn.BatchNorm(...)(x))``) or as
@@ -121,6 +132,10 @@ RULES: Dict[str, str] = {
     "J011": "nn.BatchNorm/nn.GroupNorm immediately followed by nn.relu "
             "in a model __call__ (a fused apex_tpu epilogue exists; "
             "advisory)",
+    "J012": "per-request host sync in a serving context (device_get/"
+            ".item()/block_until_ready in a while-serving loop or a "
+            "request-handler function; defer or batch the fetch — waive "
+            "only the sanctioned response boundary)",
 }
 
 #: Rules reported as advice, not errors: the CLI exits 0 when only
@@ -136,6 +151,15 @@ _J001_HOST_BOUNDARY_FUNCS = {"state_dict", "load_state_dict"}
 # then only fires inside loop bodies).
 _DRIVER_PARTS = {"examples", "tools", "tests", "docker"}
 _DRIVER_BASENAMES = {"bench.py", "setup.py", "conftest.py"}
+
+# Function names that mark per-request serving code for J012: a sync
+# anywhere in such a function is a per-request round-trip.  Exactly the
+# documented contract — ``handle*``/``serve*`` as underscore-delimited
+# segments, ``on_*`` as a PREFIX only (``train_on_batch`` must stay
+# J001 territory or existing J001 waivers would silently stop
+# covering it), plus ``handler``/``request`` substrings.
+_HANDLER_NAME_RE = re.compile(
+    r"(^|_)(handle|serve|serving)(_|$)|^_?on_|handler|request")
 
 
 class Finding(NamedTuple):
@@ -839,6 +863,10 @@ class _ScopeWalker:
         self.leafish: Set[str] = set()
         self.jit_scoped = (fn is not None
                            and fn.name in self.idx.jitted_defs)
+        # Request-handler scope for J012: syncs anywhere in a function
+        # whose NAME marks it as per-request serving code are
+        # per-request round-trips, loop or not.
+        self.handler_fn = bool(_HANDLER_NAME_RE.search(self.fn_name))
         # J009 collection: clock reads, jitted-call sites, and sync
         # points seen in this scope (line-ordered pairing happens in
         # _finish_j009 once the whole scope is walked).
@@ -850,12 +878,14 @@ class _ScopeWalker:
         self._finish_j009()
 
     def _stmts(self, body: List[ast.stmt], loop_depth: int,
-               loop_vars: frozenset, leaf_loop: bool) -> None:
+               loop_vars: frozenset, leaf_loop: bool,
+               in_while: bool = False) -> None:
         for stmt in body:
-            self._stmt(stmt, loop_depth, loop_vars, leaf_loop)
+            self._stmt(stmt, loop_depth, loop_vars, leaf_loop, in_while)
 
     def _stmt(self, stmt: ast.stmt, loop_depth: int,
-              loop_vars: frozenset, leaf_loop: bool) -> None:
+              loop_vars: frozenset, leaf_loop: bool,
+              in_while: bool = False) -> None:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return                      # nested defs are separate scopes
@@ -866,7 +896,7 @@ class _ScopeWalker:
         elif isinstance(stmt, ast.Expr):
             self._check_j005_stmt(stmt, loop_depth)
         # expression-level checks on this statement's own expressions
-        self._exprs(stmt, loop_depth, loop_vars, leaf_loop)
+        self._exprs(stmt, loop_depth, loop_vars, leaf_loop, in_while)
         # recurse into compound statements
         if isinstance(stmt, (ast.For, ast.AsyncFor)):
             new_vars = loop_vars | self._scalar_loop_vars(stmt)
@@ -890,23 +920,34 @@ class _ScopeWalker:
                 for n in ast.walk(stmt.target):
                     if isinstance(n, ast.Name) and n.id not in new_vars:
                         self.batch_vars.add(n.id)
-            self._stmts(stmt.body, loop_depth + 1, new_vars, in_leaf_loop)
-            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.body, loop_depth + 1, new_vars, in_leaf_loop,
+                        in_while)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop,
+                        in_while)
         elif isinstance(stmt, ast.While):
-            self._stmts(stmt.body, loop_depth + 1, loop_vars, leaf_loop)
-            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.body, loop_depth + 1, loop_vars, leaf_loop,
+                        True)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop,
+                        in_while)
         elif isinstance(stmt, ast.If):
             self._check_j006(stmt)
-            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop)
-            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop,
+                        in_while)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop,
+                        in_while)
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop,
+                        in_while)
         elif isinstance(stmt, ast.Try):
-            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop)
+            self._stmts(stmt.body, loop_depth, loop_vars, leaf_loop,
+                        in_while)
             for h in stmt.handlers:
-                self._stmts(h.body, loop_depth, loop_vars, leaf_loop)
-            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop)
-            self._stmts(stmt.finalbody, loop_depth, loop_vars, leaf_loop)
+                self._stmts(h.body, loop_depth, loop_vars, leaf_loop,
+                            in_while)
+            self._stmts(stmt.orelse, loop_depth, loop_vars, leaf_loop,
+                        in_while)
+            self._stmts(stmt.finalbody, loop_depth, loop_vars, leaf_loop,
+                        in_while)
 
     @staticmethod
     def _scalar_loop_vars(stmt) -> frozenset:
@@ -1013,7 +1054,8 @@ class _ScopeWalker:
                 self.arrayish.discard(name)
 
     def _exprs(self, stmt: ast.stmt, loop_depth: int,
-               loop_vars: frozenset, leaf_loop: bool) -> None:
+               loop_vars: frozenset, leaf_loop: bool,
+               in_while: bool = False) -> None:
         # own expressions only (not nested statements/defs)
         for expr in ast.iter_child_nodes(stmt):
             if isinstance(expr, (ast.stmt, ast.FunctionDef)):
@@ -1021,7 +1063,8 @@ class _ScopeWalker:
             if isinstance(expr, ast.expr):
                 for sub in ast.walk(expr):
                     if isinstance(sub, ast.Call):
-                        self._check_j001_call(sub, loop_depth, leaf_loop)
+                        self._check_j001_call(sub, loop_depth, leaf_loop,
+                                              in_while)
                         self._check_j004_call(sub, loop_depth, loop_vars)
                         self._check_j007_call(sub, loop_depth)
                         self._check_j010_call(sub, loop_depth)
@@ -1030,10 +1073,11 @@ class _ScopeWalker:
         if isinstance(stmt, ast.While):
             self._check_j006(stmt)
 
-    # .. J001 / J008 ..........................................................
+    # .. J001 / J008 / J012 ...................................................
 
     def _check_j001_call(self, call: ast.Call, loop_depth: int,
-                         leaf_loop: bool = False) -> None:
+                         leaf_loop: bool = False,
+                         in_while: bool = False) -> None:
         sync: Optional[str] = None
         d = _dotted(call.func)
         if d in ("jax.device_get", "jax.block_until_ready"):
@@ -1068,6 +1112,20 @@ class _ScopeWalker:
                 f"stack the per-leaf values into a single transfer"))
             return
         if self.driver and loop_depth == 0:
+            return
+        if in_while or self.handler_fn:
+            # The serving variant (ISSUE 11): a while-serving loop or a
+            # request-handler function syncs PER REQUEST / per decode
+            # step — reported INSTEAD of J001 (more specific rule, same
+            # replacement contract as J008).
+            where = ("in a while-serving loop" if in_while else
+                     f"in request-handler '{self.fn_name}'")
+            self.findings.append(Finding(
+                self.path, call.lineno, call.col_offset, "J012",
+                f"per-request host sync {sync} {where} — every request "
+                f"(or decode step) pays a device round-trip; defer the "
+                f"fetch one step behind or batch it, and waive only the "
+                f"sanctioned response boundary"))
             return
         where = ("inside a loop" if loop_depth else
                  f"in library function '{self.fn_name}'")
