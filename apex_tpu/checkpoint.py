@@ -114,8 +114,36 @@ def _snapshot_with_paths(tree, own=None):
     with every owned leaf's device→host copy STARTED before the first
     one is awaited (``copy_to_host_async``), so the total stall is one
     overlapped transfer instead of a serial per-leaf drain.  ``own``
-    filters leaves by flat index (per-host sharding); None takes all."""
+    filters leaves by flat index (per-host sharding); None takes all.
+
+    Cross-process global arrays (a mesh spanning hosts — ISSUE 12)
+    cannot be ``device_get``-ed piecemeal: their fetch is a COLLECTIVE
+    (``multihost_utils.process_allgather``), so when any leaf is not
+    fully addressable every process walks ALL leaves in the same order
+    (participating in each gather) and ``own`` filters only what this
+    host then WRITES — the write bytes still divide per host."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    cross_process = any(
+        hasattr(leaf, "is_fully_addressable")
+        and not leaf.is_fully_addressable for _, leaf in flat)
+    if cross_process:
+        from jax.experimental import multihost_utils
+        out = {}
+        for i, (path, leaf) in enumerate(flat):
+            if hasattr(leaf, "is_fully_addressable") \
+                    and not leaf.is_fully_addressable:
+                val = np.asarray(  # jaxlint: disable=J001 -- checkpoint snapshot: the cross-process COLLECTIVE fetch is the sanctioned materialization
+                    multihost_utils.process_allgather(leaf, tiled=True))
+            else:
+                val = np.asarray(jax.device_get(leaf))  # jaxlint: disable=J001 -- checkpoint snapshot: sanctioned host materialization
+            if own is not None and not own(i):
+                continue
+            key = _path_key(path)
+            arr, tag = _encode(val)
+            if tag is not None:
+                key = key + _DTYPE_TAG + tag
+            out[key] = arr
+        return out
     picked = [(i, _path_key(path), leaf)
               for i, (path, leaf) in enumerate(flat)
               if own is None or own(i)]
@@ -183,9 +211,15 @@ def _place_like(arr: np.ndarray, leaf):
     sharding (ISSUE 9 satellite): a resumed mesh run must get its state
     back SHARDED, not silently un-sharded host numpy.  Only committed
     shardings are honored — an uncommitted default-device leaf keeps the
-    old behavior (plain ``jnp.asarray``)."""
+    old behavior (plain ``jnp.asarray``).  A sharding spanning other
+    hosts (multi-host mesh restore, ISSUE 12) goes through
+    ``make_array_from_callback`` — every host holds the full value, each
+    transfers only its addressable shards."""
     sharding = getattr(leaf, "sharding", None)
     if sharding is not None and getattr(leaf, "committed", False):
+        if not getattr(sharding, "is_fully_addressable", True):
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
         return jax.device_put(arr, sharding)
     return jax.numpy.asarray(arr)
 
@@ -558,7 +592,12 @@ class CheckpointManager:
         self.every_steps = every_steps
         self.async_write = bool(async_write)
         if procs is None:
-            procs = (jax.process_index(), jax.process_count())
+            # One source of process identity (ISSUE 12 satellite): the
+            # multiproc helper prefers the initialized distributed
+            # runtime but falls back to the launcher env, so a spawned
+            # worker writes ITS shard even before jax.distributed is up.
+            from .parallel.multiproc import process_identity
+            procs = process_identity()
         index, count = int(procs[0]), int(procs[1])  # jaxlint: disable=J001 -- procs is a (index, count) pair of host ints, never a device value
         if not 0 <= index < count:
             raise ValueError(f"procs index {index} not in [0, {count})")

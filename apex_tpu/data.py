@@ -584,7 +584,8 @@ class DirectoryImagenet:
         if not samples:
             raise ValueError(f"no samples under {root}")
         if host_shard is True:
-            host_shard = (jax.process_index(), jax.process_count())
+            from .parallel.multiproc import process_identity
+            host_shard = process_identity()
         if host_shard is not None:
             index, count = host_shard
             if not 0 <= index < count:

@@ -29,6 +29,10 @@ from .pipeline import (spmd_pipeline, spmd_pipeline_interleaved,  # noqa: F401
                        stack_stage_params)  # noqa: F401
 from .expert_parallel import moe_layer, MoEAux  # noqa: F401
 from .zero import zero1, zero1_partition_spec, Zero1State  # noqa: F401
+from .mesh import (MeshPlan, MeshTrainStep,  # noqa: F401
+                   make_mesh_train_step, zero_sharded, MeshZeroState)
+from .multiproc import (initialize, is_coordinator,  # noqa: F401
+                        process_identity)
 
 
 def convert_syncbn_model(module: nn.Module, axis_name: str = "data",

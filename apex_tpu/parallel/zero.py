@@ -116,9 +116,17 @@ def _gather_replicated(new_local, flat_like, idx, chunk, axis_name):
 
 
 def _shard_one(flat_p, flat_g, state_inner, tx, n, idx, num_shards,
-               axis_name, apply_mask, kw):
-    """reduce-scatter + local update + gather for ONE flat buffer."""
-    from .distributed import _note_collective
+               axis_name, apply_mask, kw, *, pre_axes=(), denom=None):
+    """reduce-scatter + local update + gather for ONE flat buffer.
+
+    ``pre_axes`` names extra mesh axes the gradient must be psummed over
+    BEFORE the scatter (the mesh frontend's pure-DP axis: replicas that
+    hold the same shard chunks but saw different data); the psum runs on
+    the already-scattered chunk, so the dp wire cost is 1/shards of the
+    bucket.  ``denom`` overrides the mean divisor (the full data-replica
+    count — ``n`` times the pre-axes' sizes); default ``n``, the single-
+    axis zero1 contract."""
+    from .distributed import _axis_size, _note_collective
 
     chunk0 = -(-flat_p.size // num_shards)
     pad = chunk0 * num_shards - flat_p.size
@@ -126,9 +134,12 @@ def _shard_one(flat_p, flat_g, state_inner, tx, n, idx, num_shards,
         flat_p = jnp.pad(flat_p, (0, pad))
         flat_g = jnp.pad(flat_g, (0, pad))
     chunk = flat_p.size // n
-    # Telemetry (trace-time, ISSUE 5): the ZeRO-1 collective pair moves
-    # exactly one all-reduce's worth of bytes — half on the scatter,
-    # half on the gather.
+    # Telemetry (trace-time, ISSUE 5): the ZeRO collective pair moves
+    # exactly one all-reduce's worth of bytes over the shard axis —
+    # half on the scatter, half on the gather — plus one chunk-sized
+    # psum per pure-DP axis.  Each event carries ITS axis name so the
+    # fleet/timeline attribution can split traffic per mesh axis
+    # (dp vs fsdp) instead of pooling it (ISSUE 12).
     _note_collective("psum_scatter", axis_name,
                      flat_g.size * jnp.dtype(flat_g.dtype).itemsize, 1,
                      dtype=flat_g.dtype)
@@ -138,7 +149,14 @@ def _shard_one(flat_p, flat_g, state_inner, tx, n, idx, num_shards,
     # reduce-scatter(mean): the DDP gradient averaging, at half an
     # all-reduce, delivering only this rank's chunk.
     g_local = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
-                               tiled=True) / n
+                               tiled=True)
+    for ax in pre_axes:
+        if _axis_size(ax) > 1:
+            _note_collective("psum", ax,
+                             chunk * jnp.dtype(flat_g.dtype).itemsize, 1,
+                             dtype=flat_g.dtype)
+            g_local = lax.psum(g_local, ax)
+    g_local = g_local / (n if denom is None else denom)
     p_local = lax.dynamic_slice_in_dim(flat_p, idx * chunk, chunk)
     new_p_local, new_inner = tx.update(
         g_local, state_inner, p_local, apply_mask=apply_mask, **kw)

@@ -298,6 +298,23 @@ def analyze_fleet(streams: List[HostStream], *,
         })
         collectives.append(g)
     collectives.sort(key=lambda c: -c["bytes_per_step"])
+    # Per-mesh-axis traffic split (ISSUE 12): the axis names riding
+    # every collective event from parallel._note_collective attribute
+    # wire/wait per mesh dimension (dp vs fsdp vs tp) — "is the FSDP
+    # gather or the DP psum eating the step" becomes one read.
+    from .timeline import axis_label
+    by_axis: Dict[str, Dict[str, Any]] = {}
+    for g in collectives:
+        d = by_axis.setdefault(axis_label(g.get("axis")), {
+            "bytes_per_step": 0, "wire_ms_modeled": 0.0, "ops": set()})
+        d["bytes_per_step"] += g["bytes_per_step"]
+        d["wire_ms_modeled"] = round(
+            d["wire_ms_modeled"] + (g.get("wire_ms_modeled") or 0.0), 4)
+        d["ops"].add(g["op"])
+    by_axis = {k: {"bytes_per_step": v["bytes_per_step"],
+                   "wire_ms_modeled": v["wire_ms_modeled"],
+                   "ops": sorted(v["ops"])}
+               for k, v in sorted(by_axis.items())}
 
     # -- loader-stall asymmetry ----------------------------------------------
     stalls = {h["host"]: float(h["loader_stall_pct"] or 0.0)
@@ -326,7 +343,8 @@ def analyze_fleet(streams: List[HostStream], *,
         "collectives": {"ici_gb_s_modeled": ici_gb_s,
                         "mean_arrival_skew_ms": round(mean_arrival * 1e3,
                                                       4),
-                        "by_op": collectives},
+                        "by_op": collectives,
+                        "by_axis": by_axis},
         "loader": loader,
     }
 

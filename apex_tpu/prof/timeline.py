@@ -264,12 +264,44 @@ def analyze(events: List[dict]) -> Dict[str, Any]:
         "total_gb": round(sum(c["bytes_per_step"] for c in colls)
                           * steps / 1e9, 4),
         "by_op": colls,
+        # Per-mesh-axis attribution (ISSUE 12): the axis name(s) each
+        # collective carries split the traffic per mesh dimension
+        # (dp vs fsdp vs tp) instead of one undifferentiated pool — a
+        # multi-axis psum is labeled with the joined axes (its bytes
+        # cross every one of them as one HLO collective).
+        "by_axis": _axis_totals(colls, steps),
     }
 
     if summary is not None:
         out["summary"] = {k: v for k, v in summary.items()
                           if k not in ("t", "kind")}
     return out
+
+
+def axis_label(axis) -> str:
+    """Canonical label of a collective's mesh axis field: a bare name
+    stays itself, a multi-axis tuple joins with '+' (one HLO collective
+    crossing several axes)."""
+    if isinstance(axis, (list, tuple)):
+        return "+".join(str(a) for a in axis)
+    return str(axis)
+
+
+def _axis_totals(colls, steps: int) -> Dict[str, Dict[str, Any]]:
+    """Aggregate per-collective rows into per-axis byte totals."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for c in colls:
+        d = out.setdefault(axis_label(c.get("axis")),
+                           {"bytes_per_step": 0, "n_per_step": 0,
+                            "ops": set()})
+        d["bytes_per_step"] += c["bytes_per_step"]
+        d["n_per_step"] += c["n_per_step"]
+        d["ops"].add(c["op"])
+    return {k: {"bytes_per_step": v["bytes_per_step"],
+                "n_per_step": v["n_per_step"],
+                "total_gb": round(v["bytes_per_step"] * steps / 1e9, 4),
+                "ops": sorted(v["ops"])}
+            for k, v in sorted(out.items())}
 
 
 def _fmt_pct(v) -> str:

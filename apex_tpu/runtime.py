@@ -672,7 +672,10 @@ def stage_windows(batches: Iterable, k: int, *,
     ``data_prefetcher``'s stream-overlap, at window granularity).
     ``device`` may be a ``Sharding`` — e.g.
     ``NamedSharding(mesh, P(None, "data"))`` to shard the per-step batch
-    axis while the leading K axis stays unsharded.
+    axis while the leading K axis stays unsharded — or a
+    :class:`~apex_tpu.parallel.mesh.MeshPlan`, whose
+    ``window_sharding()`` (leading K unsharded, batch over dp×fsdp) is
+    used so the loader's placement can never drift from the step's.
 
     Returns the :class:`~apex_tpu.data.PrefetchLoader` itself — iterate
     it for ``(window, n_valid)`` pairs with ``window`` already on device
@@ -688,6 +691,8 @@ def stage_windows(batches: Iterable, k: int, *,
 
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    if hasattr(device, "window_sharding"):      # a MeshPlan (ISSUE 12)
+        device = device.window_sharding()
     # PrefetchLoader device_puts every leaf with a .shape — the window
     # arrays — and passes the plain-int n_valid through untouched.
     return PrefetchLoader(_group_batches(batches, k, pad_tail),

@@ -190,14 +190,16 @@ def _json_default(x):
 
 def _process_identity() -> tuple:
     """``(process_index, process_count)`` of this host in the fleet —
-    from jax when it is already imported (never imports it: telemetry
-    must stay usable on a stream-analysis box with no jax), else
-    ``(0, 1)``."""
+    delegated to :func:`apex_tpu.parallel.multiproc.process_identity`
+    (the one source the checkpoint shard writer also stamps with, so a
+    spawned-but-not-yet-initialized worker's stream and shards agree)
+    when jax is already imported; telemetry must stay usable on a
+    stream-analysis box with no jax, so nothing here imports it."""
     import sys
-    jax = sys.modules.get("jax")
-    if jax is not None:
+    if sys.modules.get("jax") is not None:
         try:
-            return int(jax.process_index()), int(jax.process_count())  # jaxlint: disable=J001 -- process identity is a host-side distributed-setup constant, not a device value
+            from ..parallel.multiproc import process_identity
+            return process_identity()
         except Exception:
             pass
     return 0, 1

@@ -228,7 +228,8 @@ def make_train_step(loss_fn: Callable,
                     has_model_state: bool = False,
                     scale_window: int = 2000,
                     min_loss_scale=None,
-                    max_loss_scale: float = 2.**24):
+                    max_loss_scale: float = 2.**24,
+                    param_view: Optional[Callable] = None):
     """Build ``(init_fn, step_fn)`` for one amp training step.
 
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)`` when
@@ -239,6 +240,20 @@ def make_train_step(loss_fn: Callable,
     overflow agreement and the metric pmean but skips the DDP gradient
     all-reduce — for optimizers that own the reduction themselves
     (``parallel.zero.zero1`` reduce-scatters inside ``update``).
+
+    ``param_view`` maps the STORED parameter pytree to the tree
+    ``loss_fn`` consumes, INSIDE the differentiated function — so its
+    transpose runs in the backward and the optimizer sees gradients in
+    the stored layout.  This is the ZeRO-3 hook
+    (``apex_tpu.parallel.mesh``): the stored params are sharded flat
+    buckets, the view all-gathers and unpacks them, and autodiff
+    transposes the gather into exactly the reduce-scatter a ZeRO
+    optimizer wants — per-bucket, so chunked stores overlap the
+    collectives with the surrounding compute.  The opt-level compute
+    cast applies AFTER the view (on the full tree, normal O2
+    semantics).  Under ``accum_steps > 1`` the view is hoisted out of
+    the microbatch scan alongside the cast — one gather per step, not
+    per microbatch.  Default: identity.
 
     ``accum_steps=N`` is gradient accumulation compiled INTO the step —
     the jitted analog of the reference's ``delay_unscale`` micro-batch
@@ -274,12 +289,17 @@ def make_train_step(loss_fn: Callable,
     keep_bn = props.keep_batchnorm_fp32
     keep_bn = True if keep_bn is None else keep_bn
 
-    def compute_cast(params):
+    view = param_view if param_view is not None else (lambda p: p)
+
+    def cast_only(params):
         if cast_in_step:
             return _policy.convert_params(params, cast_dtype,
                                           keep_norm_fp32=keep_bn,
                                           norm_predicate=norm_predicate)
         return params
+
+    def compute_cast(params):
+        return cast_only(view(params))
 
     def init_fn(params, model_state=None):
         if store_dtype_cast:  # O3: store reduced precision, no masters
@@ -323,8 +343,17 @@ def make_train_step(loss_fn: Callable,
             # whole-tree cast per step, not per microbatch).  Its
             # transpose is an upcast, which is the identity on the fp32
             # accumulator — so the mean gradient w.r.t. the cast params
-            # IS the master gradient.
-            cp = compute_cast(state.params)
+            # IS the master gradient.  The param_view is hoisted the
+            # same way, but its transpose (the ZeRO-3 reduce-scatter)
+            # is NOT the identity: jax.vjp stages it once so the
+            # accumulated full-tree gradient is mapped back to the
+            # stored layout after the scan — one gather and one scatter
+            # per step, not per microbatch.
+            if param_view is not None:
+                full, view_vjp = jax.vjp(view, state.params)
+            else:
+                full, view_vjp = state.params, None
+            cp = cast_only(full)
 
             def scaled_loss_cp(cp_, ms, mb):
                 if has_model_state:
@@ -345,9 +374,11 @@ def make_train_step(loss_fn: Callable,
                 return (new_ms, g_acc, l_acc + l / accum_steps), None
 
             g0 = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), state.params)
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), full)
             (new_ms, grads, loss), _ = jax.lax.scan(
                 one_micro, (state.model_state, g0, jnp.float32(0.0)), micro)
+            if view_vjp is not None:
+                grads, = view_vjp(grads)
 
         if axis_name is not None and reduce_grads:
             grads = reduce_gradients(

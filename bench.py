@@ -196,6 +196,15 @@ _TEL_STALL_TOL_PCT = 2.0
 _CKPT_ASYNC_OVER_SYNC_GATE = 0.20
 _CKPT_SYNC_FLOOR_MS = 1.0
 
+# ISSUE 12 (mesh frontend): ZeRO-3 per-device param+optimizer-state
+# bytes must scale ~1/shard_count on the probe mesh (8-way: ideal
+# 0.125; the gate leaves room for the replicated scaler scalars and
+# step counters), and the REAL 2-process CPU multi-host fixture
+# (gloo collectives, per-host checkpoint shards, fleet merge of the
+# two real streams) must pass end to end.
+_MESH_Z3_RATIO_GATE = 0.16
+_MESH_PROBE_DEVICES = 8
+
 
 def _gate_implied(name, implied, peak, measured_max):
     if implied >= peak:
@@ -1224,6 +1233,54 @@ def _bench_fleet():
     }
 
 
+def _bench_mesh():
+    """ISSUE 12 self-validation, backend-independent (both probes run
+    as CPU subprocesses so the on-chip bench and the CI smoke measure
+    the same thing):
+
+    * **ZeRO-3 memory scaling** — ``tools/mesh_memory_probe.py`` on a
+      forced 8-device CPU mesh: per-device param+optimizer-state bytes
+      from the committed shardings (exact), corroborated by the
+      compiled sharded step's ``memory_analysis`` through
+      ``prof.memory`` where the backend exposes it.  main() gates the
+      ratio at ~1/shard_count.
+    * **multi-host fixture** — ``tools/multihost_smoke.py --nproc 2``:
+      REAL processes joined via ``multiproc.initialize`` (gloo
+      collectives), bitwise cross-host metric parity, one checkpoint
+      shard per host, fleet merge of the two real telemetry streams.
+    """
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(f"--xla_force_host_platform_device_count="
+                          f"{_MESH_PROBE_DEVICES}"),
+               APEX_PROBE_REPO=root)
+    out = {}
+    probe = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "mesh_memory_probe.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    if probe.returncode == 0:
+        try:
+            out["memory"] = json.loads(probe.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            out["memory"] = {"error": "unparseable probe output"}
+    else:
+        out["memory"] = {"error": f"probe exited {probe.returncode}",
+                         "stderr": probe.stderr[-2000:]}
+    smoke = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "multihost_smoke.py"),
+         "--nproc", "2"],
+        env=dict(os.environ), capture_output=True, text=True, timeout=600)
+    try:
+        out["multihost"] = json.loads(smoke.stdout)
+    except ValueError:
+        out["multihost"] = {"ok": False,
+                            "error": f"smoke exited {smoke.returncode}",
+                            "stderr": smoke.stderr[-2000:]}
+    return out
+
+
 def _bench_checkpoint():
     """ISSUE 9 self-validation: measure ``checkpoint_stall_ms_per_step``
     on one pipelined training loop under three regimes — no
@@ -2189,6 +2246,27 @@ def main():
             f"format_loader_line no longer share one snapshot; refusing "
             f"to report.")
 
+    # Mesh-frontend self-validation (ISSUE 12), backend-independent:
+    # ZeRO-3 must actually divide per-device state bytes by the shard
+    # count, and the REAL 2-process multi-host fixture must pass.
+    extra["mesh"] = mz = _bench_mesh()
+    z3 = (mz.get("memory") or {}).get("zero3") or {}
+    if z3.get("ratio") is None or z3["ratio"] > _MESH_Z3_RATIO_GATE:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: ZeRO-3 per-device state ratio "
+            f"{z3.get('ratio')} (gate <= {_MESH_Z3_RATIO_GATE} on the "
+            f"{_MESH_PROBE_DEVICES}-way probe mesh; "
+            f"memory={mz.get('memory')}) — the sharded flat buckets are "
+            f"not actually dividing param+optimizer-state memory; "
+            f"refusing to report.")
+    if not (mz.get("multihost") or {}).get("ok"):
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: the 2-process multi-host fixture "
+            f"did not pass ({mz.get('multihost')}) — real cross-process "
+            f"mesh parity, per-host checkpoint shards, or the fleet "
+            f"merge of the two live streams is broken; refusing to "
+            f"report.")
+
     # Async-checkpoint self-validation (ISSUE 9), backend-independent:
     # the engine's whole point is that the loop pays only the snapshot
     # trigger — if the async stall creeps toward the synchronous
@@ -2433,6 +2511,10 @@ def main():
                 "it_per_sec_best_window"),
             "dcgan_example_window_gap_pct": dc.get("window_gap_pct"),
             "dcgan_example_loader_stall_pct": dc.get("loader_stall_pct"),
+            "zero3_state_ratio_8way": ((extra["mesh"].get("memory") or {})
+                                       .get("zero3") or {}).get("ratio"),
+            "multihost_fixture_ok": (extra["mesh"].get("multihost")
+                                     or {}).get("ok"),
             "serving_tokens_per_s": extra["serving"].get("tokens_per_s"),
             "serving_p99_latency_ms": (
                 extra["serving"].get("p99_latency_ms")),

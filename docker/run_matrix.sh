@@ -42,8 +42,11 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # expositions.  test_serving.py rides for the inference engine (ISSUE
 # 11): the paged cache, AOT bucket table, scheduler, and hot-swap are
 # host machinery over plain XLA programs, so every degradation tier
-# must serve bitwise-identical greedy tokens.
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py tests/test_serving.py -q"
+# must serve bitwise-identical greedy tokens.  test_mesh.py rides for
+# the mesh frontend (ISSUE 12): the ZeRO-2/3 sharding engine is pure
+# XLA collectives over the flat-bucket store, so every tier must hold
+# the bitwise zero1-parity and 1/N state-sharding contracts.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py tests/test_serving.py tests/test_mesh.py -q -m 'not slow'"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native
@@ -59,6 +62,14 @@ APEX_TPU_DISABLE_PALLAS=1 $FAST
 
 echo "=== tier 4: bare (both fallbacks) ==="
 APEX_TPU_DISABLE_NATIVE=1 APEX_TPU_DISABLE_PALLAS=1 $FAST
+
+echo "=== multi-host lane: 2 REAL processes (ISSUE 12) ==="
+# Spawns 2 subprocesses with distinct process ids joined through
+# jax.distributed (gloo CPU collectives): mesh parity must hold
+# bitwise ACROSS hosts, CheckpointManager must land one shard per
+# host, and prof.fleet must merge the two real telemetry streams.
+# The script manages its own per-child XLA_FLAGS.
+python tools/multihost_smoke.py --nproc 2
 
 echo "=== cross-run regression gate (prof.regress, ISSUE 7) ==="
 # Diff the freshest bench headline against the checked-in r05 baseline:

@@ -234,3 +234,23 @@ def test_imagenet_example_unfused_flags(monkeypatch, capsys):
         "--no-aot-warmup"])
     out = capsys.readouterr().out
     assert "done" in out
+
+
+@pytest.mark.parametrize("zero", [2, 3])
+def test_mesh_example(monkeypatch, capsys, zero):
+    """The mesh-frontend flagship: plan declaration, ZeRO sharding,
+    AOT-warmed pipeline, state-bytes ledger (ISSUE 12)."""
+    cpus = jax.devices("cpu")[:4]
+    orig_devices = jax.devices
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda *a, **kw: orig_devices(*a, **kw) if a or kw else cpus)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    _run_example(monkeypatch, "examples/simple/mesh/fsdp_train.py",
+                 ["--zero", str(zero), "--steps", "8",
+                  "--steps-per-call", "4", "--fsdp", "4", "--batch", "4"])
+    out = capsys.readouterr().out
+    assert "done" in out
+    assert "ratio" in out
+    if zero == 3:
+        assert "0.25" in out          # params+state divided 4 ways

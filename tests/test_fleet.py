@@ -271,3 +271,20 @@ def test_real_recorders_merge(tmp_path):
     a = fleet.analyze_fleet(streams)
     assert len(a["windows"]) == 3
     assert all(w["slowest_host"] == 3 for w in a["windows"])
+
+
+def test_collectives_attributed_per_axis(fixture_dir):
+    """ISSUE 12 satellite: the axis names riding each collective event
+    split the fleet wire model per mesh axis instead of one pool."""
+    streams = fleet.load_fleet([str(fixture_dir / "host*.jsonl")])
+    a = fleet.analyze_fleet(streams, ici_gb_s=100.0)
+    by_axis = a["collectives"]["by_axis"]
+    assert "data" in by_axis
+    d = by_axis["data"]
+    assert d["bytes_per_step"] == 4_000_000
+    assert "psum" in d["ops"]
+    # the per-axis wire model is the sum of that axis's per-op rows
+    want = round(sum(c["wire_ms_modeled"]
+                     for c in a["collectives"]["by_op"]
+                     if c["axis"] == ["data"] or c["axis"] == "data"), 4)
+    assert d["wire_ms_modeled"] == want

@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J010 a known-bad snippet
+1. fixture self-tests — for every rule J001-J013 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -1150,3 +1150,90 @@ def test_j012_interior_on_segment_stays_j001():
     assert _codes(src) == ["J001"]
     prefixed = src.replace("train_on_batch", "on_request")
     assert _codes(prefixed) == ["J012"]
+
+
+# -- J013: unsharded parameter staging in multi-device entry points (ISSUE 12)-
+
+def test_j013_flags_bare_device_put_in_mesh_function():
+    bad = """
+    import jax
+    from jax.sharding import Mesh
+
+    def launch(params, batch):
+        mesh = Mesh(jax.devices(), ("data",))
+        params = jax.device_put(params)
+        return mesh
+    """
+    assert _codes(bad) == ["J013"]
+
+
+def test_j013_flags_jnp_asarray_of_params_in_mesh_function():
+    bad = """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    def setup(weights):
+        w = jnp.asarray(weights)
+        return NamedSharding
+    """
+    assert _codes(bad) == ["J013"]
+
+
+def test_j013_explicit_sharding_passes():
+    ok = """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def launch(params):
+        mesh = Mesh(jax.devices(), ("data",))
+        sh = NamedSharding(mesh, P())
+        params = jax.device_put(params, sh)
+        other = jax.device_put(params, device=sh)
+        return params, other
+    """
+    assert _codes(ok) == []
+
+
+def test_j013_only_fires_in_multi_device_functions():
+    """A bare device_put in single-device code is normal staging — the
+    rule needs the mesh marker (Mesh/MeshPlan/shard_map/NamedSharding)
+    in the same function."""
+    ok = """
+    import jax
+
+    def stage(params):
+        return jax.device_put(params)
+    """
+    assert _codes(ok) == []
+
+
+def test_j013_only_parameter_sized_names_flag():
+    """A scalar/batch staged without a sharding is noise, not a finding
+    — the name heuristic keeps the rule to parameter-sized arrays."""
+    ok = """
+    import jax
+    from jax.sharding import Mesh
+
+    def launch(flag):
+        mesh = Mesh(jax.devices(), ("data",))
+        f = jax.device_put(flag)
+        return mesh
+    """
+    assert _codes(ok) == []
+
+
+def test_j013_is_advisory_and_waivable():
+    from tools.jaxlint.linter import Finding
+
+    assert Finding("p", 1, 0, "J013", "m").advisory
+    waived = """
+    import jax
+    from jax.sharding import Mesh
+
+    def launch(params):
+        mesh = Mesh(jax.devices(), ("data",))
+        params = jax.device_put(params)  # jaxlint: disable=J013 -- single-host tool, placement irrelevant
+        return mesh
+    """
+    assert _codes(waived) == []

@@ -1,0 +1,463 @@
+"""apex_tpu.parallel.mesh — the unified N-D sharding frontend (ISSUE 12).
+
+The acceptance contracts:
+
+* a DP×FSDP training step on the 8-device CPU mesh matches the existing
+  ``zero1(bucketed=True)`` path BITWISE (same seed, 20 steps);
+* zero steady-state retraces under ``prof.assert_trace_count`` after
+  ``StepPipeline.warmup`` of the sharded step;
+* ZeRO-3 per-device param+optimizer-state bytes scale ~1/shard_count;
+* ``multiproc.initialize``/``process_identity`` resolve identity from
+  the environment, idempotently.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import runtime, training
+from apex_tpu.multi_tensor.buckets import Packed
+from apex_tpu.parallel import mesh as M
+from apex_tpu.parallel import multiproc
+from apex_tpu.parallel.zero import zero1, zero1_partition_spec
+from apex_tpu.prof import assert_trace_count
+from apex_tpu.training import TrainState, make_train_step
+
+NDEV = 8
+STEPS = 20
+
+
+def _setup():
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(5, 7) * 0.3, jnp.float32),
+              "b": jnp.zeros((3,), jnp.float32)}   # 38 elems: pads to 40
+    x = jnp.asarray(rng.randn(8 * NDEV, 5), jnp.float32)
+    y = jnp.asarray(rng.randn(8 * NDEV, 7) * 0.1, jnp.float32)
+    return params, x, y
+
+
+def _loss_fn(p, batch):
+    xb, yb = batch
+    pred = xb @ p["w"].astype(jnp.float32) + jnp.pad(
+        p["b"].astype(jnp.float32), (0, 4))
+    return jnp.mean((pred - yb) ** 2)
+
+
+def _run_zero1_baseline(steps=STEPS):
+    """The pre-mesh path: zero1(bucketed=True) on a flat 8-way axis."""
+    mesh = Mesh(np.array(jax.devices("cpu")[:NDEV]), ("data",))
+    params, x, y = _setup()
+    tx = zero1(training.adam(1e-2), "data", num_shards=NDEV, bucketed=True)
+    init_fn, step_fn = make_train_step(_loss_fn, tx, opt_level="O2",
+                                       loss_scale="dynamic",
+                                       axis_name=("data",),
+                                       reduce_grads=False)
+    state = init_fn(params)
+    spec = TrainState(params=P(),
+                      opt_state=zero1_partition_spec(state.opt_state,
+                                                     "data"),
+                      scaler=P(), model_state=P())
+    step = jax.jit(shard_map(step_fn, mesh=mesh,
+                             in_specs=(spec, (P("data"), P("data"))),
+                             out_specs=(spec, P())))
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, (x, y))
+        losses.append(float(jnp.ravel(m["loss"])[0]))
+    return np.asarray(losses), jax.device_get(state.params)
+
+
+def _run_mesh(zero, dp, fsdp, steps=STEPS):
+    params, x, y = _setup()
+    plan = M.MeshPlan(dp=dp, fsdp=fsdp,
+                      devices=jax.devices("cpu")[:dp * fsdp])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                zero=zero, opt_level="O2",
+                                loss_scale="dynamic")
+    state = ms.init(params)
+    step = ms.jit_step(state, donate=False)
+    batch = plan.device_put_batch((x, y))
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, batch)
+        losses.append(float(jnp.ravel(m["loss"])[0]))
+    return (np.asarray(losses), jax.device_get(ms.gather_params(state)),
+            state, ms, plan)
+
+
+# -- plan declaration ---------------------------------------------------------
+
+def test_plan_validates_sizes_and_derives_axes():
+    devs = jax.devices("cpu")[:8]
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=devs)
+    assert plan.world_size == 8 and plan.data_world == 8
+    assert plan.data_axes == ("dp", "fsdp")
+    assert plan.mesh.shape == {"dp": 2, "fsdp": 4, "tp": 1}
+    assert "dp=2" in repr(plan)
+    with pytest.raises(ValueError, match="dp\\*fsdp\\*tp"):
+        M.MeshPlan(dp=3, fsdp=4, devices=devs)
+    with pytest.raises(ValueError, match=">= 1"):
+        M.MeshPlan(dp=0, fsdp=8, devices=devs)
+
+
+def test_plan_auto_fills_dp():
+    devs = jax.devices("cpu")[:8]
+    plan = M.MeshPlan.auto(devices=devs)          # pure FSDP default
+    assert (plan.dp, plan.fsdp, plan.tp) == (1, 8, 1)
+    plan = M.MeshPlan.auto(fsdp=4, devices=devs)
+    assert (plan.dp, plan.fsdp) == (2, 4)
+
+
+def test_plan_derived_shardings_agree():
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+    assert plan.batch_spec == P(("dp", "fsdp"))
+    assert plan.flat_spec == P("fsdp")
+    x = jnp.arange(16.0).reshape(16, 1)
+    placed = plan.device_put_batch(x)
+    assert placed.sharding == plan.batch_sharding()
+    assert placed.committed                       # warmup can pin it
+
+
+# -- bitwise parity with the pre-mesh zero1 path (acceptance) -----------------
+
+@pytest.mark.parametrize("zero,dp,fsdp", [(2, 2, 4), (3, 2, 4), (3, 1, 8)])
+def test_mesh_zero_matches_zero1_bitwise(zero, dp, fsdp):
+    """DP×FSDP on the 8-device CPU mesh, 20 steps, same seed: losses
+    AND final params bitwise-equal to zero1(bucketed=True) — the mesh
+    frontend is a re-plumbing, not a renumbering."""
+    base_losses, base_params = _run_zero1_baseline()
+    losses, params, state, ms, plan = _run_mesh(zero, dp, fsdp)
+    np.testing.assert_array_equal(base_losses, losses)
+    for k in base_params:
+        np.testing.assert_array_equal(np.asarray(base_params[k]),
+                                      np.asarray(params[k]))
+    assert losses[-1] < losses[0]
+
+
+def test_zero3_state_is_actually_sharded():
+    """ZeRO-3 per-device param+optimizer-state bytes ~ 1/shard_count."""
+    _, _, state, ms, plan = _run_mesh(3, 1, 8, steps=1)
+    led = plan.state_bytes((state.params, state.opt_state))
+    # 8-way sharding: one device holds ~1/8 of the flat buckets (the
+    # scaler scalars and step counters stay replicated, hence ~)
+    assert led["ratio"] <= 1.0 / 8 + 0.05, led
+    # and the flat buckets really carry the fsdp sharding
+    for b in state.params.data:
+        assert b.sharding == plan.flat_sharding()
+        shard = b.sharding.shard_shape(b.shape)
+        assert shard[0] == b.shape[0] // 8
+
+
+def test_zero2_params_stay_replicated_state_sharded():
+    _, _, state, ms, plan = _run_mesh(2, 2, 4, steps=1)
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.sharding.is_fully_replicated
+    led = plan.state_bytes(state.opt_state)
+    assert led["ratio"] <= 1.0 / 4 + 0.1, led
+
+
+def test_zero3_pipeline_warmup_zero_retraces():
+    """The sharded step through StepPipeline: AOT warmup, then ZERO
+    traces for the whole run (acceptance), trajectory bitwise equal to
+    the per-step zero1 baseline."""
+    K = 4
+    base_losses, _ = _run_zero1_baseline(steps=3 * K)
+    params, x, y = _setup()
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                zero=3, opt_level="O2",
+                                loss_scale="dynamic")
+    state = ms.init(params)
+    pipe = runtime.StepPipeline(ms.step_fn, K,
+                                wrap=ms.pipeline_wrap(state))
+
+    def window():
+        w = jax.tree_util.tree_map(
+            lambda a: np.broadcast_to(np.asarray(a), (K,) + a.shape),
+            (x, y))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, plan.window_sharding()), w)
+
+    pipe.warmup(state, window())
+    losses = []
+    with assert_trace_count(pipe.loop, 0):
+        for _ in range(3):
+            state, metrics = pipe.step_window(state, window(), K)
+            losses += [float(v) for v in
+                       np.ravel(jax.device_get(metrics["loss"]))]
+    np.testing.assert_array_equal(base_losses, np.asarray(losses))
+
+
+def test_zero3_overflow_on_one_shard_skips_everywhere():
+    """One fsdp shard's inf grads must skip the step on EVERY rank —
+    the mesh-wide overflow agreement zero1 pioneered, across BOTH axes."""
+    params, x, y = _setup()
+    x = np.array(x)
+    x[0, 0] = np.inf                              # shard (dp=0, fsdp=0)
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                zero=3, opt_level="O2",
+                                loss_scale="dynamic")
+    state = ms.init(params)
+    step = ms.jit_step(state, donate=False)
+    state1, m = step(state, plan.device_put_batch((jnp.asarray(x), y)))
+    # params untouched (global skip), moments finite, scale halved
+    p0 = ms.gather_params(state)
+    p1 = ms.gather_params(state1)
+    for k in p0:
+        np.testing.assert_array_equal(np.asarray(p0[k]), np.asarray(p1[k]))
+    for leaf in jax.tree_util.tree_leaves(state1.opt_state):
+        assert np.all(np.isfinite(np.asarray(leaf, np.float32)))
+    assert float(state1.scaler.loss_scale) == 2.0 ** 15
+
+
+@pytest.mark.parametrize("zero", [2, 3])
+def test_decay_mask_and_buckets_forwarded(zero):
+    """Regression: make_mesh_train_step used to drop max_bucket_elems /
+    decay_mask on the zero<3 path, and neither level zeroed
+    weight_decay on the no-decay buckets a mask splits off."""
+    params, x, y = _setup()
+
+    def run(weight_decay, decay_mask):
+        plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+        ms = M.make_mesh_train_step(
+            _loss_fn, training.adam(1e-2, weight_decay=weight_decay),
+            plan, zero=zero, opt_level="O0", decay_mask=decay_mask,
+            max_bucket_elems=16)
+        state = ms.init(params)
+        step = ms.jit_step(state, donate=False)
+        batch = plan.device_put_batch((x, y))
+        for _ in range(3):      # b leaves its zero init, so decay bites
+            state, _ = step(state, batch)
+        return jax.device_get(ms.gather_params(state)), state
+
+    all_off, _ = run(0.5, {"w": False, "b": False})
+    no_wd, _ = run(0.0, None)
+    decayed, dstate = run(0.5, None)
+    # an all-False mask must neutralize weight_decay exactly — bitwise
+    # equal to the weight_decay=0 run (same bucket chunking, same math)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(all_off[k]),
+                                      np.asarray(no_wd[k]))
+    # and without the mask, decay genuinely moves every leaf
+    # (b leaves its zero init at step 1, so steps 2-3 decay it too)
+    for k in params:
+        assert not np.array_equal(np.asarray(all_off[k]),
+                                  np.asarray(decayed[k])), k
+    # max_bucket_elems reached the store: even without a mask, w (35
+    # elems) and b (3) can't share one 16-cap bucket, so the optimizer
+    # state is multi-bucket
+    assert len(dstate.opt_state.inner) >= 2
+
+
+def test_zero3_accum_steps_applies_view_transpose():
+    """Regression: accum_steps>1 with ZeRO-3 used to crash at trace
+    time (the hoisted compute cast dropped the param_view, so the
+    accumulated grads came back in the full-tree layout).  The view is
+    now hoisted via jax.vjp — one gather per step, its transpose (the
+    reduce-scatter) applied once to the accumulated gradient — so the
+    trajectory matches the unaccumulated step."""
+    params, x, y = _setup()
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+
+    def run(accum_steps, steps=5):
+        ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                    zero=3, opt_level="O2",
+                                    loss_scale="dynamic",
+                                    accum_steps=accum_steps)
+        state = ms.init(params)
+        step = ms.jit_step(state, donate=False)
+        batch = plan.device_put_batch((x, y))
+        losses = []
+        for _ in range(steps):
+            state, m = step(state, batch)
+            losses.append(float(jnp.ravel(m["loss"])[0]))
+        return np.asarray(losses), jax.device_get(ms.gather_params(state))
+
+    base_losses, base_params = run(1, steps=1)
+    acc_losses, acc_params = run(2, steps=1)
+    # mean-reduced MSE is batch-size invariant: after ONE step only the
+    # float reassociation of the microbatch mean separates the runs —
+    # a missing/wrong view transpose would be off by the gather factor
+    np.testing.assert_allclose(acc_losses, base_losses, rtol=1e-5)
+    for k in base_params:
+        np.testing.assert_allclose(np.asarray(acc_params[k]),
+                                   np.asarray(base_params[k]),
+                                   rtol=1e-5, atol=1e-6)
+    # and the accumulated trajectory keeps training (adam amplifies the
+    # reassociation noise over steps, so no bitwise pin here)
+    acc_losses, _ = run(2, steps=5)
+    assert np.all(np.isfinite(acc_losses)) and acc_losses[-1] < acc_losses[0]
+
+
+# -- contracts & rejections ---------------------------------------------------
+
+def test_zero_sharded_rejects_per_tensor_norm_optimizers():
+    plan = M.MeshPlan(dp=1, fsdp=8, devices=jax.devices("cpu")[:8])
+    with pytest.raises(ValueError, match="elementwise"):
+        M.zero_sharded(training.lamb(1e-3), plan, level=2)
+    with pytest.raises(ValueError, match="elementwise"):
+        M.zero_sharded(training.novograd(1e-3), plan, level=3)
+    with pytest.raises(ValueError, match="level"):
+        M.zero_sharded(training.adam(1e-3), plan, level=4)
+    with pytest.raises(ValueError, match="level"):
+        # regression: an out-of-range level must not fall through to
+        # the zero-3 branch of the frontend
+        M.make_mesh_train_step(_loss_fn, training.adam(1e-3), plan,
+                               zero=5)
+
+
+def test_zero3_rejects_reduced_precision_storage():
+    plan = M.MeshPlan(dp=1, fsdp=8, devices=jax.devices("cpu")[:8])
+    with pytest.raises(ValueError, match="fp32 flat buckets"):
+        M.make_mesh_train_step(_loss_fn, training.adam(1e-3), plan,
+                               zero=3, opt_level="O3")
+
+
+def test_zero3_step_before_init_raises():
+    plan = M.MeshPlan(dp=1, fsdp=8, devices=jax.devices("cpu")[:8])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-3), plan,
+                                zero=3)
+    with pytest.raises(RuntimeError, match="init"):
+        ms.step_fn(None, None)
+    with pytest.raises(RuntimeError, match="init"):
+        ms.store()
+
+
+def test_zero3_store_and_bucket_layout_for_checkpoints():
+    params, _, _ = _setup()
+    plan = M.MeshPlan(dp=1, fsdp=8, devices=jax.devices("cpu")[:8])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-3), plan,
+                                zero=3)
+    state = ms.init(params)
+    store = ms.store()
+    layout = plan.bucket_layout(store)
+    assert layout == {"sizes": [38], "num_shards": 8}
+    assert isinstance(state.params, Packed)
+    assert state.params.data[0].shape == (40,)    # padded_shard_len(38, 8)
+
+
+# -- multiproc: identity & launch ---------------------------------------------
+
+def test_multiproc_identity_from_env(monkeypatch):
+    monkeypatch.setenv("RANK", "3")
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    assert multiproc.process_identity() == (3, 4)
+    assert not multiproc.is_coordinator()
+    monkeypatch.setenv("RANK", "0")
+    assert multiproc.is_coordinator()
+    # jax-native spellings win over torchrun's
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    assert multiproc.process_identity() == (1, 4)
+
+
+def test_multiproc_identity_rejects_out_of_range(monkeypatch):
+    monkeypatch.setenv("RANK", "7")
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    with pytest.raises(ValueError, match="not in"):
+        multiproc.process_identity()
+
+
+def test_multiproc_single_process_initialize_is_noop_and_idempotent(
+        monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    saved = dict(multiproc._STATE)
+    try:
+        multiproc._STATE.update(initialized=False, procs=None)
+        assert multiproc.initialize() == (0, 1)
+        assert multiproc.initialize() == (0, 1)   # idempotent
+        assert multiproc.process_identity() == (0, 1)
+        assert multiproc.is_coordinator()
+    finally:
+        multiproc._STATE.update(saved)
+
+
+def test_multiproc_worker_env_round_trips():
+    env = multiproc.worker_env(1, 2, "127.0.0.1:9999", base={})
+    assert env["JAX_PROCESS_ID"] == "1" and env["RANK"] == "1"
+    assert env["JAX_NUM_PROCESSES"] == "2" and env["WORLD_SIZE"] == "2"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "127.0.0.1:9999"
+
+
+def test_checkpoint_manager_adopts_multiproc_identity(tmp_path,
+                                                      monkeypatch):
+    """The ISSUE 12 satellite: a spawned worker's CheckpointManager
+    shards by the LAUNCHER env even before jax.distributed is up."""
+    from apex_tpu.checkpoint import CheckpointManager
+
+    monkeypatch.setenv("RANK", "1")
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.procs == (1, 2)
+    mgr.close()
+
+
+def test_telemetry_recorder_stamps_multiproc_identity(tmp_path,
+                                                      monkeypatch):
+    import json
+
+    from apex_tpu import telemetry
+
+    monkeypatch.setenv("RANK", "1")
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    path = str(tmp_path / "run.jsonl")
+    rec = telemetry.Recorder(path)
+    rec.close()
+    run_ev = [json.loads(l) for l in open(path) if l.strip()][0]
+    assert run_ev["process_index"] == 1
+    assert run_ev["process_count"] == 2
+
+
+@pytest.mark.slow
+def test_real_two_process_multihost_smoke():
+    """The full multi-host gate: 2 REAL processes, gloo collectives,
+    bitwise cross-host parity, per-host checkpoint shards, fleet merge
+    (also run by docker/run_matrix.sh and bench.py)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "multihost_smoke.py"), "--nproc", "2"],
+        capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_mesh_collectives_note_their_axis(tmp_path):
+    """The ZeRO-3 step's trace-time collective events carry the mesh
+    axis they cross — fsdp for the param gather/grad scatter, dp for
+    the replica psum — so fleet/timeline attribution can split them."""
+    import json
+
+    from apex_tpu import telemetry
+
+    params, x, y = _setup()
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                zero=3, opt_level="O2")
+    path = str(tmp_path / "run.jsonl")
+    rec = telemetry.start(path)
+    try:
+        state = ms.init(params)
+        step = ms.jit_step(state, donate=False)
+        state, m = step(state, plan.device_put_batch((x, y)))
+        jax.block_until_ready(m["loss"])
+    finally:
+        rec.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    colls = [e for e in events if e.get("kind") == "collective"]
+    by_op = {}
+    for e in colls:
+        by_op.setdefault(e["op"], set()).add(
+            e["axis"] if isinstance(e["axis"], str)
+            else tuple(e["axis"]))
+    assert "fsdp" in by_op.get("all_gather", set())
+    assert "fsdp" in by_op.get("reduce_scatter", set())
+    assert "dp" in by_op.get("psum", set())

@@ -502,3 +502,33 @@ def test_timeline_tolerates_torn_tail_line(tmp_path):
         f.write('{"t": 0.2, "kind": "wind')      # killed mid-write
     a = timeline.analyze(timeline.load_events(path))
     assert a["steps"] == 1
+
+
+def test_timeline_collectives_attribute_per_axis():
+    """ISSUE 12 satellite: per-collective byte totals split per mesh
+    axis (dp vs fsdp vs joint) instead of one undifferentiated pool."""
+    events = [
+        {"t": 0.0, "kind": "run", "meta": {}},
+        {"t": 0.04, "kind": "retrace", "program": "hot", "step": 0,
+         "n_traces": 1, "first": True, "new_sig": True, "sig": "s"},
+        {"t": 0.1, "kind": "window", "step": 0, "k": 4, "n_valid": 4,
+         "dur": 0.05, "gap": 0.0, "program": "hot"},
+        {"t": 0.05, "kind": "collective", "op": "all_gather",
+         "axis": "fsdp", "bytes": 4000, "n": 1, "dtype": "float32"},
+        {"t": 0.06, "kind": "collective", "op": "reduce_scatter",
+         "axis": "fsdp", "bytes": 4000, "n": 1, "dtype": "float32"},
+        {"t": 0.07, "kind": "collective", "op": "psum",
+         "axis": "dp", "bytes": 500, "n": 1, "dtype": "float32"},
+        {"t": 0.08, "kind": "collective", "op": "psum",
+         "axis": ["dp", "fsdp"], "bytes": 64, "n": 1,
+         "dtype": "float32"},
+    ]
+    a = timeline.analyze(events)
+    by_axis = a["collectives"]["by_axis"]
+    assert set(by_axis) == {"fsdp", "dp", "dp+fsdp"}
+    assert by_axis["fsdp"]["bytes_per_step"] == 8000
+    assert by_axis["fsdp"]["ops"] == ["all_gather", "reduce_scatter"]
+    assert by_axis["dp"]["bytes_per_step"] == 500
+    assert by_axis["dp+fsdp"]["bytes_per_step"] == 64
+    assert (a["collectives"]["per_step_bytes"]
+            == sum(v["bytes_per_step"] for v in by_axis.values()))

@@ -34,7 +34,9 @@ PAGES = {
                  "apex_tpu.parallel.tensor_parallel",
                  "apex_tpu.parallel.pipeline",
                  "apex_tpu.parallel.expert_parallel",
-                 "apex_tpu.parallel.zero"],
+                 "apex_tpu.parallel.zero",
+                 "apex_tpu.parallel.mesh",
+                 "apex_tpu.parallel.multiproc"],
     "normalization": ["apex_tpu.normalization",
                       "apex_tpu.normalization.fused_bn_act"],
     "ops": ["apex_tpu.ops.flash_attention", "apex_tpu.ops.attention",

@@ -1,6 +1,6 @@
 """jaxlint core — AST rules, waiver handling, and the lint engine.
 
-Rules J001–J012 tuned to this codebase's failure modes (the ones that are
+Rules J001–J013 tuned to this codebase's failure modes (the ones that are
 invisible to pytest and surface as 10x dispatch-floor regressions in
 ``bench.py``):
 
@@ -88,6 +88,16 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   the two elementwise sweeps into one pass (ISSUE 7).  Advisory
   severity: reported, waivable, and never fails the CLI on its own —
   the chain is correct, just slower than it needs to be.
+* **J013** (advisory) unsharded parameter staging in multi-device
+  entry points: ``jax.device_put`` with no sharding argument, or
+  ``jnp.asarray``, of a parameter-sized array (name matches
+  ``param*``/``state``/``weight*``/``master*``/``moment*``/
+  ``opt_state``/``grad*``) inside a function that constructs or maps
+  a mesh (``Mesh``/``MeshPlan``/``shard_map``/``NamedSharding``/
+  ``make_mesh_train_step``).  The bare put lands the array uncommitted
+  on one device: the partitioner reshuffles it per sharded call and
+  AOT warmup cannot pin the placement — derive it from the plan
+  (``plan.named(...)``/``plan.batch_sharding()``) instead (ISSUE 12).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -136,11 +146,16 @@ RULES: Dict[str, str] = {
             ".item()/block_until_ready in a while-serving loop or a "
             "request-handler function; defer or batch the fetch — waive "
             "only the sanctioned response boundary)",
+    "J013": "device_put/jnp.asarray of a parameter-sized array without "
+            "an explicit NamedSharding inside a multi-device entry "
+            "point (the array lands replicated/on one device and the "
+            "partitioner reshuffles it per call; derive the placement "
+            "from the MeshPlan; advisory)",
 }
 
 #: Rules reported as advice, not errors: the CLI exits 0 when only
 #: advisory findings remain, and ``Finding.advisory`` marks them.
-ADVISORY_RULES: Set[str] = {"J011"}
+ADVISORY_RULES: Set[str] = {"J011", "J013"}
 
 # Functions whose *contract* is the host boundary: serialization must
 # materialize host values, so J001 does not fire inside them.  Everything
@@ -822,6 +837,83 @@ def _check_j011(tree: ast.Module, path: str) -> List[Finding]:
     return findings
 
 
+# -- J013: unsharded parameter staging in multi-device entry points -----------
+
+#: a function that touches any of these is a "multi-device entry
+#: point": it constructs or maps over a mesh, so every array it stages
+#: has a RIGHT placement the partitioner cannot infer from a bare put.
+_J013_MESH_MARKERS = {"Mesh", "MeshPlan", "shard_map", "NamedSharding",
+                      "make_mesh_train_step", "make_mesh"}
+
+#: names that look parameter-sized (the arrays whose silent
+#: replication costs HBM and a reshuffle; a scalar metric staged
+#: without a sharding is noise, not a finding)
+_J013_PARAMISH_RE = re.compile(
+    r"(^|_)(params?|state|weights?|masters?|moments?|opt_state|grads?)"
+    r"(_|$|\d)", re.IGNORECASE)
+
+_J013_ASARRAY = {"jnp.asarray", "jax.numpy.asarray",
+                 "jnp.array", "jax.numpy.array"}
+
+
+def _j013_is_mesh_fn(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        name = _dotted(node) if isinstance(node, (ast.Name,
+                                                  ast.Attribute)) else None
+        if name and name.split(".")[-1] in _J013_MESH_MARKERS:
+            return True
+    return False
+
+
+def _j013_paramish(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return bool(_J013_PARAMISH_RE.search(node.id))
+    if isinstance(node, ast.Attribute):
+        return bool(_J013_PARAMISH_RE.search(node.attr)) \
+            or _j013_paramish(node.value)
+    return False
+
+
+def _check_j013(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _j013_is_mesh_fn(fn):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name and name.split(".")[-1] == "device_put":
+                # an explicit second arg / device= / sharding kwarg IS
+                # the placement — only the bare single-arg put flags
+                explicit = (len(node.args) >= 2
+                            or any(k.arg in ("device", "sharding", "dst")
+                                   for k in node.keywords))
+                if not explicit and node.args \
+                        and _j013_paramish(node.args[0]):
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset, "J013",
+                        "device_put of a parameter-sized array with no "
+                        "sharding inside a multi-device entry point — "
+                        "it lands on one device (or replicated) and "
+                        "every sharded call reshuffles it; pass the "
+                        "NamedSharding the mesh plan derives "
+                        "(plan.named(...)/plan.batch_sharding())"))
+            elif name in _J013_ASARRAY:
+                if node.args and _j013_paramish(node.args[0]):
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset, "J013",
+                        "jnp.asarray of a parameter-sized array inside "
+                        "a multi-device entry point stages it "
+                        "uncommitted on the default device — "
+                        "device_put with the plan-derived NamedSharding "
+                        "instead, so warmup and restore pin the "
+                        "placement"))
+    return findings
+
+
 # -- per-scope walker: J001, J004, J005, J006 ---------------------------------
 
 class _ScopeWalker:
@@ -1408,6 +1500,7 @@ def lint_source(src: str, path: str = "<string>",
     findings += _check_j002(idx, path)
     findings += _check_j003(tree, path)
     findings += _check_j011(tree, path)
+    findings += _check_j013(tree, path)
     _ScopeWalker(idx, path, driver, findings).lint_module(tree)
     kept = [f for f in findings if not waivers.waived(f)]
     kept += waivers.errors

@@ -1,0 +1,77 @@
+"""ZeRO-3 memory-scaling probe (ISSUE 12 acceptance evidence).
+
+Runs in its own process on a forced N-device CPU mesh (the parent sets
+``XLA_FLAGS``/``JAX_PLATFORMS``) and prints a JSON ledger comparing
+per-device param+optimizer-state bytes under ZeRO-3 (fsdp=N) against
+the replicated ZeRO-2 params baseline:
+
+* ``zero3.ratio`` — bytes one device holds / global bytes, from the
+  committed shardings (``MeshPlan.state_bytes``, exact);
+* ``zero3.xla`` — the compiled sharded step's ``memory_analysis``
+  argument/output/temp bytes via ``prof.memory.stats_from_analysis``
+  where the backend exposes it (recorded; null on backends that
+  don't).
+
+``bench.py`` gates ``zero3.ratio`` at ~1/shard_count and records the
+whole ledger in BENCH_EXTRA.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ.get(
+    "APEX_PROBE_REPO",
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import training
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.prof import memory as prof_memory
+
+    devs = jax.devices()
+    n = len(devs)
+    rng = np.random.RandomState(0)
+    # ~1.05M fp32 params -> ~4.2 MB params, ~12.6 MB more as O2
+    # masters'+moments' flat buckets
+    params = {"w": jnp.asarray(rng.randn(1024, 1024) * 0.02, jnp.float32),
+              "b": jnp.zeros((1024,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    out = {"devices": n}
+    for zero, key in ((3, "zero3"), (2, "zero2")):
+        plan = M.MeshPlan(dp=1, fsdp=n, devices=devs)
+        ms = M.make_mesh_train_step(loss_fn, training.adam(1e-3), plan,
+                                    zero=zero, opt_level="O2")
+        state = ms.init(params)
+        led = plan.state_bytes((state.params, state.opt_state))
+        entry = dict(led, shard_count=n,
+                     params_bytes=plan.state_bytes(state.params))
+        step = ms.jit_step(state, donate=False)
+        x = jnp.asarray(rng.randn(8 * n, 1024), jnp.float32)
+        y = jnp.asarray(rng.randn(8 * n, 1024), jnp.float32)
+        batch = plan.device_put_batch((x, y))
+        try:
+            compiled = step.lower(state, batch).compile()  # jaxlint: disable=J010 -- one AOT compile per probed zero level (2 total), the probe's whole purpose
+            entry["xla"] = prof_memory.stats_from_analysis(
+                compiled.memory_analysis())  # jaxlint: disable=J010 -- single read of the probe executable's ledger
+        except Exception as e:
+            entry["xla"] = None
+            entry["xla_error"] = f"{type(e).__name__}: {e}"
+        out[key] = entry
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

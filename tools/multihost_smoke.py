@@ -1,0 +1,294 @@
+"""Real 2-process CPU multi-host fixture (ISSUE 12 gate).
+
+Spawns ``--nproc`` worker processes with distinct process ids, each of
+which joins the distributed runtime through
+``apex_tpu.parallel.multiproc.initialize`` (env autodetect, gloo CPU
+collectives), builds the SAME global :class:`MeshPlan` per process, and
+trains a small ZeRO-sharded model with REAL cross-process collectives.
+The parent then validates the whole multi-host story end to end:
+
+* **mesh parity** — every worker's loss trajectory is bitwise identical
+  (the replicated metrics of one SPMD program), and matches a
+  single-process run of the same global mesh within float tolerance;
+* **per-host checkpoint shards** — ``CheckpointManager`` (process
+  identity from ``multiproc``, not ad-hoc ``jax.process_index``) wrote
+  one shard + manifest part per host, and the merged checkpoint
+  validates;
+* **fleet merge** — ``prof.fleet`` merges the two REAL telemetry
+  streams (not the synthetic fixture) and attributes both hosts.
+
+Run directly (CI lane in ``docker/run_matrix.sh``)::
+
+    python tools/multihost_smoke.py --nproc 2
+
+Exit 0 + a JSON verdict on stdout; ``bench.py`` invokes it as the
+multi-process self-validation gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEVS_PER_PROC = 2
+STEPS = 6
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_code() -> str:
+    # One source file for worker AND single-process reference: the
+    # reference simply skips initialize() and sees all devices locally.
+    return WORKER
+
+
+WORKER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["APEX_SMOKE_REPO"])
+import jax
+jax.config.update("jax_default_matmul_precision", "highest")
+import numpy as np
+import jax.numpy as jnp
+
+from apex_tpu import telemetry, training
+from apex_tpu.checkpoint import CheckpointManager
+from apex_tpu.parallel import mesh as M
+from apex_tpu.parallel import multiproc
+
+out_dir = os.environ["APEX_SMOKE_OUT"]
+role = os.environ["APEX_SMOKE_ROLE"]          # "worker" | "reference"
+steps = int(os.environ["APEX_SMOKE_STEPS"])
+
+if role == "worker":
+    pid, nproc = multiproc.initialize()       # env autodetect
+    env_rank = int(os.environ["JAX_PROCESS_ID"])
+    assert pid == env_rank, (pid, env_rank)
+    assert multiproc.process_identity() == (pid, nproc)
+else:
+    pid, nproc = 0, 1
+
+world = jax.device_count()
+plan = M.MeshPlan(dp=1, fsdp=world)
+
+rng = np.random.RandomState(0)
+params = {"w": jnp.asarray(rng.randn(6, 4) * 0.3, jnp.float32),
+          "b": jnp.zeros((4,), jnp.float32)}
+x_global = rng.randn(8 * world, 6).astype(np.float32)
+y_global = (rng.randn(8 * world, 4) * 0.1).astype(np.float32)
+
+
+def loss_fn(p, batch):
+    xb, yb = batch
+    pred = xb @ p["w"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+    return jnp.mean((pred - yb) ** 2)
+
+
+rec = None
+if role == "worker":
+    rec = telemetry.start(os.path.join(out_dir, f"host{pid}.jsonl"),
+                          meta={"fixture": "multihost_smoke"})
+
+ms = M.make_mesh_train_step(loss_fn, training.adam(1e-2), plan,
+                            zero=3, opt_level="O2", loss_scale="dynamic")
+state = ms.init(params)
+step = ms.jit_step(state, donate=False)
+
+# each process feeds its host-local slice; device_put_batch globalizes
+per = x_global.shape[0] // nproc
+sl = slice(pid * per, (pid + 1) * per)
+batch = plan.device_put_batch((jnp.asarray(x_global[sl]),
+                               jnp.asarray(y_global[sl])))
+
+losses = []
+for _ in range(steps):
+    state, metrics = step(state, batch)
+    losses.append(float(np.ravel(jax.device_get(metrics["loss"]))[0]))  # jaxlint: disable=J001 -- fixture verdict: the replicated loss is the cross-process parity evidence
+
+# replicated parameter checksum: psum of local squared chunks
+from jax import lax
+from jax.sharding import PartitionSpec as P
+spec = ms.state_spec(state)
+
+
+def sqsum(pk):
+    acc = jnp.float64(0.0) if jax.config.read("jax_enable_x64") \
+        else jnp.float32(0.0)
+    for b in pk.data:
+        acc = acc + lax.psum(jnp.sum(jnp.square(b)), plan.fsdp_axis)
+    return acc
+
+
+check = jax.jit(plan.shard_map(sqsum, in_specs=(spec.params,),
+                               out_specs=P()))(state.params)
+param_sqsum = float(np.ravel(jax.device_get(check))[0])  # jaxlint: disable=J001 -- fixture verdict read
+
+ck_ok = None
+if role == "worker":
+    mgr = CheckpointManager(os.path.join(out_dir, "ckpt"), keep=1)
+    assert mgr.procs == (pid, nproc), (mgr.procs, pid, nproc)
+    store = ms.store()
+    mgr.save(steps, state, block=True,
+             bucket_layout=plan.bucket_layout(store))
+    mgr.close()
+    ck_ok = True
+    rec.close()
+
+with open(os.path.join(out_dir, f"result_{role}_{pid}.json"), "w") as f:
+    json.dump({"role": role, "pid": pid, "nproc": nproc, "world": world,
+               "losses": losses, "param_sqsum": param_sqsum,
+               "is_coordinator": multiproc.is_coordinator(),
+               "checkpoint": ck_ok}, f)
+print(f"{role} {pid}/{nproc} done", flush=True)
+"""
+
+
+def run(nproc: int = 2, out_dir: str = None, verbose: bool = True) -> dict:
+    import shutil
+    import tempfile
+
+    own_dir = out_dir is None
+    if own_dir:
+        out_dir = tempfile.mkdtemp(prefix="apex_tpu_multihost_")
+    os.makedirs(out_dir, exist_ok=True)
+    worker_py = os.path.join(out_dir, "_worker.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER)
+
+    sys.path.insert(0, REPO)
+    from apex_tpu.parallel.multiproc import worker_env
+
+    base = dict(os.environ)
+    base.pop("XLA_FLAGS", None)
+    base.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={DEVS_PER_PROC}",
+        APEX_SMOKE_REPO=REPO, APEX_SMOKE_OUT=out_dir,
+        APEX_SMOKE_STEPS=str(STEPS))
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(nproc):
+        env = worker_env(rank, nproc, coordinator, base=base)
+        env["APEX_SMOKE_ROLE"] = "worker"
+        log = open(os.path.join(out_dir, f"worker_{rank}.log"), "w")
+        procs.append((rank, subprocess.Popen(
+            [sys.executable, worker_py], env=env,
+            stdout=log, stderr=subprocess.STDOUT), log))
+    # single-process reference over the SAME global device count
+    ref_env = dict(base)
+    ref_env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                            f"{DEVS_PER_PROC * nproc}")
+    ref_env["APEX_SMOKE_ROLE"] = "reference"
+    ref_log = open(os.path.join(out_dir, "reference.log"), "w")
+    ref = subprocess.Popen([sys.executable, worker_py], env=ref_env,
+                           stdout=ref_log, stderr=subprocess.STDOUT)
+
+    failures = []
+    for rank, p, log in procs:
+        rc = p.wait(timeout=600)
+        log.close()
+        if rc != 0:
+            failures.append(f"worker {rank} exited {rc}")
+    rc = ref.wait(timeout=600)
+    ref_log.close()
+    if rc != 0:
+        failures.append(f"reference exited {rc}")
+    if failures and verbose:
+        for rank in range(nproc):
+            lp = os.path.join(out_dir, f"worker_{rank}.log")
+            if os.path.exists(lp):
+                print(f"--- worker {rank} log ---", file=sys.stderr)
+                sys.stderr.write(open(lp).read()[-4000:])
+        lp = os.path.join(out_dir, "reference.log")
+        if os.path.exists(lp):
+            print("--- reference log ---", file=sys.stderr)
+            sys.stderr.write(open(lp).read()[-4000:])
+
+    verdict = {"nproc": nproc, "devs_per_proc": DEVS_PER_PROC,
+               "steps": STEPS, "spawn_failures": failures}
+    if not failures:
+        results = {}
+        for path in glob.glob(os.path.join(out_dir, "result_*.json")):
+            with open(path) as f:
+                r = json.load(f)
+            results[(r["role"], r["pid"])] = r
+        workers = [results[("worker", i)] for i in range(nproc)]
+        reference = results[("reference", 0)]
+        # 1) bitwise across hosts: one SPMD program's replicated metrics
+        verdict["parity_bitwise_across_hosts"] = all(
+            w["losses"] == workers[0]["losses"]
+            and w["param_sqsum"] == workers[0]["param_sqsum"]
+            for w in workers[1:])
+        # 2) vs single-process same-mesh reference (collective impls
+        # differ: gloo ring vs local — tolerance, not bitwise)
+        ref_l = reference["losses"]
+        w_l = workers[0]["losses"]
+        verdict["max_rel_loss_diff_vs_single"] = max(
+            abs(a - b) / max(abs(a), 1e-12) for a, b in zip(ref_l, w_l))
+        verdict["parity_vs_single_process"] = (
+            verdict["max_rel_loss_diff_vs_single"] < 1e-5)
+        verdict["coordinator_elected_once"] = (
+            sum(1 for w in workers if w["is_coordinator"]) == 1
+            and workers[0]["is_coordinator"])
+        # 3) per-host checkpoint shards
+        from apex_tpu.checkpoint import latest_checkpoint
+        step_dir = latest_checkpoint(os.path.join(out_dir, "ckpt"))
+        shards = (sorted(glob.glob(os.path.join(step_dir, "shard_*.npz")))
+                  if step_dir else [])
+        verdict["checkpoint_valid"] = step_dir is not None
+        verdict["checkpoint_shards"] = len(shards)
+        # 4) fleet merge over the two REAL streams
+        try:
+            from apex_tpu.prof import fleet
+            streams = fleet.load_fleet(
+                [os.path.join(out_dir, "host*.jsonl")])
+            merged = fleet.analyze_fleet(streams)
+            verdict["fleet_n_hosts"] = merged.get("n_hosts")
+            verdict["fleet_hosts_attributed"] = (
+                len(merged.get("hosts") or []) == nproc)
+            by_axis = ((merged.get("collectives") or {})
+                       .get("by_axis") or {})
+            verdict["fleet_axes_attributed"] = sorted(by_axis)
+        except Exception as e:                       # pragma: no cover
+            verdict["fleet_error"] = f"{type(e).__name__}: {e}"
+        verdict["ok"] = bool(
+            verdict["parity_bitwise_across_hosts"]
+            and verdict["parity_vs_single_process"]
+            and verdict["coordinator_elected_once"]
+            and verdict["checkpoint_valid"]
+            and verdict["checkpoint_shards"] == nproc
+            and verdict.get("fleet_n_hosts") == nproc)
+    else:
+        verdict["ok"] = False
+    if own_dir and verdict["ok"]:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    elif not verdict["ok"]:
+        verdict["out_dir"] = out_dir
+    return verdict
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--nproc", type=int, default=2)
+    parser.add_argument("--out-dir", default=None)
+    args = parser.parse_args(argv)
+    verdict = run(args.nproc, args.out_dir)
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
