@@ -8,6 +8,10 @@ pure apply function), so ``initialize`` consumes and returns *param trees* and
 * O2/O3: params are cast to bf16 (keep-batchnorm-fp32 honored via path
   heuristics — ``policy.convert_params``), the optimizer is wired with fp32
   master weights, and the returned params are the *model* (bf16) copy.
+* O4: identical storage handling to O2 (bf16 cast, fp32 masters, loss
+  scaling); the int8 routing itself is a MODEL property — build the
+  model with the ``quant=`` hook (``apex_tpu.quant``, ISSUE 13) and the
+  annotated matmuls dispatch the quantized kernels inside the step.
 * O1: the autocast policy over jnp/lax is enabled (``autocast.init``),
   params stay fp32.
 * O0: everything fp32, loss scale 1.0.
@@ -71,7 +75,8 @@ def initialize(models=None,
     if opt_level not in opt_levels:
         raise AmpOptionError(
             "Unexpected optimization level {!r}; options are 'O0', 'O1', "
-            "'O2', 'O3'. Note the 'O' is the letter O.".format(opt_level))
+            "'O2', 'O3', 'O4'. Note the 'O' is the letter O.".format(
+                opt_level))
 
     properties = opt_levels[opt_level]()
     maybe_print("apex_tpu.amp: opt_level {}".format(opt_level), True)

@@ -70,6 +70,7 @@ class Properties:
         "master_weights",
         "loss_scale",
         "cast_model_outputs",
+        "quantize",
     )
 
     def __init__(self):
@@ -82,6 +83,7 @@ class Properties:
             "master_weights": None,
             "loss_scale": 1.0,
             "cast_model_outputs": None,
+            "quantize": False,
         }
 
     # -- access -------------------------------------------------------------
@@ -107,10 +109,15 @@ class Properties:
                     "O1 inserts casts around individual ops rather than casting the "
                     "model; cast_model_type is not allowed with opt_level O1.")
         elif name == "patch_functions":
-            if value and self.opt_level in ("O2", "O3"):
+            if value and self.opt_level in ("O2", "O3", "O4"):
                 raise AmpOptionError(
                     "patch_functions (the O1 autocast policy) cannot be combined "
-                    "with a whole-model cast (O2/O3).")
+                    "with a whole-model cast (O2/O3/O4).")
+            if value and self.options.get("quantize"):
+                raise AmpOptionError(
+                    "patch_functions (the O1 autocast policy) cannot be "
+                    "combined with quantize (the O4 int8 path composes "
+                    "with a whole-model cast, O2 semantics).")
         elif name == "keep_batchnorm_fp32":
             if isinstance(value, str):
                 if value.lower() not in ("true", "false"):
@@ -129,6 +136,15 @@ class Properties:
                     raise AmpOptionError("loss_scale must be positive")
         elif name == "cast_model_outputs":
             value = _canonical_dtype(value)
+        elif name == "quantize":
+            if not isinstance(value, bool):
+                raise AmpOptionError(
+                    "quantize must be a bool, got {!r}".format(value))
+            if value and self.patch_functions:
+                raise AmpOptionError(
+                    "quantize (the O4 int8 path) composes with a "
+                    "whole-model cast (O2 semantics), not with the O1 "
+                    "autocast policy.")
         self.__dict__["options"][name] = value
 
     def __repr__(self):
@@ -161,6 +177,20 @@ def _make_preset(name, doc, **opts):
 
 
 # Presets (reference frontend.py:102-191).  Note bf16 + static scale defaults.
+O4 = _make_preset(
+    "O4", "Calibrated int8 mixed precision (ISSUE 13): EXACT O2 storage "
+          "semantics — bf16 model cast, fp32 batchnorm, fp32 master "
+          "weights, loss scaling — plus annotated matmuls (the models' "
+          "quant= hook) running the int8 quantized kernels.  Without a "
+          "frozen calibration every site falls back bitwise to O2.",
+    cast_model_type=jnp.bfloat16,
+    patch_functions=False,
+    keep_batchnorm_fp32=True,
+    master_weights=True,
+    loss_scale=1.0,
+    quantize=True,
+)
+
 O3 = _make_preset(
     "O3", "Pure reduced precision (bf16). Fast but no fp32 batchnorm safety net.",
     cast_model_type=jnp.bfloat16,
@@ -199,4 +229,4 @@ O0 = _make_preset(
     loss_scale=1.0,
 )
 
-opt_levels = {"O3": O3, "O2": O2, "O1": O1, "O0": O0}
+opt_levels = {"O4": O4, "O3": O3, "O2": O2, "O1": O1, "O0": O0}

@@ -18,6 +18,33 @@ import jax
 from ..normalization import FusedLayerNorm
 
 
+def _dense_factory(quant, dtype):
+    """The ISSUE 13 projection-factory hook (the ``norm_cls`` pattern of
+    PR 7, matmul edition): returns ``dense(name, features, axis=-1)``.
+    With a :class:`~apex_tpu.quant.layers.QuantConfig` attached every
+    projection builds as the parameter-compatible
+    :class:`~apex_tpu.quant.layers.QuantDenseGeneral` (int8 kernels on
+    calibrated sites, bitwise fallback elsewhere); without one it builds
+    the exact flax module it always was — the one place holding that
+    conditional for every model family."""
+    qd = None
+    if quant is not None:
+        import functools
+
+        from ..quant.layers import QuantDenseGeneral
+        qd = functools.partial(QuantDenseGeneral, quant=quant)
+
+    def dense(name, features, axis=-1):
+        if qd is not None:
+            return qd(features, axis=axis, dtype=dtype, name=name)
+        if axis == -1 and isinstance(features, int):
+            return nn.Dense(features, dtype=dtype,
+                            param_dtype=jnp.float32, name=name)
+        return nn.DenseGeneral(features, axis=axis, dtype=dtype,
+                               param_dtype=jnp.float32, name=name)
+    return dense
+
+
 class BertSelfAttention(nn.Module):
     """Self-attention with a pluggable compute strategy.
 
@@ -58,6 +85,9 @@ class BertSelfAttention(nn.Module):
     # driving ``apply()`` directly must bound their own loop.
     decode: bool = False
     cache_len: int = 0
+    # quantization hook (ISSUE 13): a quant.QuantConfig routes the
+    # q/k/v/out projections through the int8 kernels (_dense_factory).
+    quant: Any = None
 
     @nn.compact
     def __call__(self, x, mask=None, *, kv_cache=None, positions=None):
@@ -67,9 +97,9 @@ class BertSelfAttention(nn.Module):
         if self.num_heads % n_kv:
             raise ValueError(f"num_kv_heads {n_kv} must divide "
                              f"num_heads {self.num_heads}")
-        dense = lambda name, heads: nn.DenseGeneral(
-            (heads, head_dim), dtype=self.dtype,
-            param_dtype=jnp.float32, name=name)
+        proj = _dense_factory(self.quant, self.dtype)
+        dense = lambda name, heads: proj(name, (heads, head_dim))
+        out_proj = lambda: proj("out", d, axis=(-2, -1))
         q = dense("query", self.num_heads)(x)
         k = dense("key", n_kv)(x)
         v = dense("value", n_kv)(x)
@@ -85,8 +115,7 @@ class BertSelfAttention(nn.Module):
             ctx, kf, vf = self._incremental(q, k, v, kv_cache, positions,
                                             mask)
             ctx = ctx.astype(x.dtype)
-            out = nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
-                                  param_dtype=jnp.float32, name="out")(ctx)
+            out = out_proj()(ctx)
             return out, (kf, vf)
         if n_kv != self.num_heads and self.attention_impl not in (
                 "flash", "blockwise", "full"):
@@ -186,8 +215,7 @@ class BertSelfAttention(nn.Module):
             ctx = dot_product_attention(q, k, v, causal=self.causal,
                                         bias=bias)
         ctx = ctx.astype(x.dtype)
-        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype,
-                               param_dtype=jnp.float32, name="out")(ctx)
+        return out_proj()(ctx)
 
     def _incremental(self, q, k, v, kv_cache, positions, mask):
         """Incremental attention over an externally-owned dense cache
@@ -248,22 +276,23 @@ class BertLayer(nn.Module):
     attention_impl: str = "full"
     sp_axis: Optional[str] = None
     num_kv_heads: Optional[int] = None
+    quant: Any = None
 
     @nn.compact
     def __call__(self, x, mask=None):
         d = x.shape[-1]
+        mlp = _dense_factory(self.quant, self.dtype)
         attn = BertSelfAttention(self.num_heads, self.dtype,
                                  attention_impl=self.attention_impl,
                                  sp_axis=self.sp_axis,
                                  num_kv_heads=self.num_kv_heads,
+                                 quant=self.quant,
                                  name="attention")(x, mask)
         x = FusedLayerNorm(normalized_shape=d, name="attention_ln")(
             x + attn).astype(x.dtype)
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="intermediate")(x)
+        h = mlp("intermediate", self.mlp_dim)(x)
         h = nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
-        h = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
-                     name="output")(h)
+        h = mlp("output", d)(h)
         return FusedLayerNorm(normalized_shape=d, name="output_ln")(
             x + h).astype(x.dtype)
 
@@ -281,6 +310,7 @@ class BertEncoder(nn.Module):
     attention_impl: str = "full"   # full | blockwise | flash | ring | ulysses
     sp_axis: Optional[str] = None      # mesh axis for ring/ulysses
     num_kv_heads: Optional[int] = None  # GQA; flash/blockwise/full impls
+    quant: Any = None                  # ISSUE 13 int8 projection hook
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
@@ -309,6 +339,7 @@ class BertEncoder(nn.Module):
                           attention_impl=self.attention_impl,
                           sp_axis=self.sp_axis,
                           num_kv_heads=self.num_kv_heads,
+                          quant=self.quant,
                           name=f"layer_{i}")(x, attention_mask)
         if self.num_classes is None:
             return x.astype(jnp.float32)

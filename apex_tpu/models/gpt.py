@@ -30,12 +30,13 @@ class GPTBlock(nn.Module):
     window: Optional[int] = None         # sliding-window local attention
     decode: bool = False                 # KV-cache single-token decode
     cache_len: int = 0
+    quant: Any = None                    # ISSUE 13 int8 projection hook
 
     @nn.compact
     def __call__(self, x, *, kv_cache=None, positions=None):
         d = x.shape[-1]
         h = FusedLayerNorm(normalized_shape=d, name="ln1")(x).astype(x.dtype)
-        from .bert import BertSelfAttention
+        from .bert import BertSelfAttention, _dense_factory
         attn = BertSelfAttention(self.num_heads, self.dtype,
                                  attention_impl=self.attention_impl,
                                  sp_axis=self.sp_axis, causal=True,
@@ -43,6 +44,7 @@ class GPTBlock(nn.Module):
                                  window=self.window,
                                  decode=self.decode,
                                  cache_len=self.cache_len,
+                                 quant=self.quant,
                                  name="attention")
         new_cache = None
         if kv_cache is not None:
@@ -51,11 +53,10 @@ class GPTBlock(nn.Module):
             h = attn(h)
         x = x + h
         h = FusedLayerNorm(normalized_shape=d, name="ln2")(x).astype(x.dtype)
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlp_up")(h)
+        mlp = _dense_factory(self.quant, self.dtype)
+        h = mlp("mlp_up", self.mlp_dim)(h)
         h = nn.gelu(h.astype(jnp.float32)).astype(x.dtype)
-        h = nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
-                     name="mlp_down")(h)
+        h = mlp("mlp_down", d)(h)
         if new_cache is not None:
             return x + h, new_cache
         return x + h
@@ -76,6 +77,7 @@ class GPT(nn.Module):
     num_kv_heads: Optional[int] = None   # GQA (llama-style); None = MHA
     window: Optional[int] = None         # sliding-window local attention
     decode: bool = False                 # KV-cache autoregressive decode
+    quant: Any = None                    # ISSUE 13 int8 projection hook
 
     @nn.compact
     def __call__(self, input_ids, *, kv_caches=None, positions=None):
@@ -108,6 +110,7 @@ class GPT(nn.Module):
                                 sp_axis=None,
                                 num_kv_heads=self.num_kv_heads,
                                 window=self.window,
+                                quant=self.quant,
                                 name=f"block_{i}")(
                                     x, kv_cache=kv_caches[i],
                                     positions=positions)
@@ -152,6 +155,7 @@ class GPT(nn.Module):
                          window=self.window,
                          decode=self.decode,
                          cache_len=self.max_len,
+                         quant=self.quant,
                          name=f"block_{i}")(x)
         x = FusedLayerNorm(normalized_shape=self.hidden_size,
                            name="ln_f")(x)

@@ -423,23 +423,41 @@ def _zero3_tx(tx, plan: MeshPlan, FunctionalOptimizer, decay_flags=None):
 
 # -- the step frontend --------------------------------------------------------
 
-def _gather_view(store: BucketStore, plan: MeshPlan) -> Callable:
+def _gather_view(store: BucketStore, plan: MeshPlan,
+                 gather_dtype=None) -> Callable:
     """The ZeRO-3 ``param_view``: per-bucket all-gather over fsdp +
     unpack back to the template tree.  Runs INSIDE the differentiated
     loss, so its transpose (slice-pad + ``reduce_scatter``) is the grad
     schedule.  Per-invocation bytes are noted per bucket on the fsdp
     axis — once for the forward gather, once for the backward scatter
-    the transpose will emit."""
+    the transpose will emit.
+
+    ``gather_dtype`` (the ROADMAP mesh-round-2 bf16-gather): cast each
+    fsdp-sharded flat bucket to the wire dtype BEFORE the gather and
+    back after, halving wire bytes both ways — the transpose of the
+    downcast is the upcast, so the backward reduce-scatters bf16 grad
+    chunks and hands the optimizer fp32 again.  The fp32 MASTERS are
+    untouched (only the in-step view quantizes); ``None`` keeps the
+    bitwise fp32 path.  Only float buckets wider than the wire dtype
+    cast — an already-narrow bucket ships as-is."""
+    wire = None if gather_dtype is None else jnp.dtype(gather_dtype)
+
     def view(packed: Packed):
         full = []
         for bi, b in enumerate(store.buckets):
             buf = packed.data[bi]
-            nbytes = buf.size * plan.fsdp * jnp.dtype(buf.dtype).itemsize
+            cast = (wire is not None
+                    and jnp.issubdtype(buf.dtype, jnp.floating)
+                    and jnp.dtype(buf.dtype).itemsize > wire.itemsize)
+            sent = buf.astype(wire) if cast else buf
+            nbytes = (sent.size * plan.fsdp
+                      * jnp.dtype(sent.dtype).itemsize)
             _note_collective("all_gather", plan.fsdp_axis, nbytes, 1,
-                             dtype=buf.dtype)
+                             dtype=sent.dtype)
             _note_collective("reduce_scatter", plan.fsdp_axis, nbytes, 1,
-                             dtype=buf.dtype)
-            g = lax.all_gather(buf, plan.fsdp_axis, tiled=True)
+                             dtype=sent.dtype)
+            g = lax.all_gather(sent, plan.fsdp_axis, tiled=True)
+            g = g.astype(buf.dtype) if cast else g
             full.append(g[:b.size])
         return store.unpack(Packed(data=tuple(full), rest=packed.rest))
     return view
@@ -501,6 +519,7 @@ def make_mesh_train_step(loss_fn: Callable, tx, plan: MeshPlan, *,
                          opt_level: str = "O2",
                          max_bucket_elems: Optional[int] = None,
                          decay_mask=None,
+                         gather_dtype=None,
                          has_model_state: bool = False,
                          **train_kw) -> MeshTrainStep:
     """Build a sharded training step from one :class:`MeshPlan`.
@@ -513,25 +532,38 @@ def make_mesh_train_step(loss_fn: Callable, tx, plan: MeshPlan, *,
     :func:`~apex_tpu.training.make_train_step` (loss_scale,
     accum_steps, scale_window, ...).
 
-    ZeRO-3 restriction: ``opt_level`` must keep fp32 storage (O0/O1/O2
+    ZeRO-3 restriction: ``opt_level`` must keep fp32 storage (O0/O1/O2/O4
     — master weights are the flat buckets); O3's bf16 storage would
     need per-bucket keep-norm splits and is rejected loudly.
+
+    ``gather_dtype`` (ZeRO-3 only): wire dtype for the ``param_view``
+    all-gather / grad reduce-scatter — ``jnp.bfloat16`` halves the
+    per-step FSDP wire bytes while the stored fp32 masters stay exact
+    (the compute cast was shipping bf16 into the matmuls anyway; the
+    bf16 wire moves the rounding one op earlier).  ``None`` (default)
+    keeps the bitwise fp32 wire.
     """
     from .. import training
 
     if zero not in (1, 2, 3):
         raise ValueError(f"zero level must be 1, 2, or 3, got {zero}")
-    if zero == 3 and opt_level not in ("O0", "O1", "O2"):
+    if zero == 3 and opt_level not in ("O0", "O1", "O2", "O4"):
         raise ValueError(
             f"zero=3 stores params as fp32 flat buckets (the masters); "
             f"opt_level {opt_level!r} stores reduced precision — use "
-            f"O0/O1/O2, or zero<=2 for O3")
+            f"O0/O1/O2/O4, or zero<=2 for O3")
 
     store_kw = {}
     if max_bucket_elems is not None:
         store_kw["max_bucket_elems"] = max_bucket_elems
     if decay_mask is not None:
         store_kw["decay_mask"] = decay_mask
+
+    if zero != 3 and gather_dtype is not None:
+        raise ValueError(
+            "gather_dtype shapes the ZeRO-3 param_view wire; zero<3 "
+            "replicates params and never gathers them — drop the "
+            "argument or use zero=3")
 
     if zero < 3:
         z_tx = zero_sharded(tx, plan, level=zero, **store_kw)
@@ -568,7 +600,8 @@ def make_mesh_train_step(loss_fn: Callable, tx, plan: MeshPlan, *,
                 loss_fn, z_tx, opt_level=opt_level,
                 axis_name=plan.all_axes, reduce_grads=False,
                 has_model_state=has_model_state,
-                param_view=_gather_view(store, plan), **train_kw)
+                param_view=_gather_view(store, plan, gather_dtype),
+                **train_kw)
             z3_holder.clear()            # one live template at a time
             z3_holder[id(store)] = built
             z3_holder["latest"] = built

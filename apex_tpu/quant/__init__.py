@@ -1,0 +1,46 @@
+"""apex_tpu.quant — int8/fp8-style low-precision engine (ISSUE 13).
+
+The layer below bf16: calibrated symmetric-absmax int8 quantization for
+the matmuls that dominate the step, wired through four existing layers —
+
+* :mod:`.kernels` — Pallas quantize → int8×int8→int32 matmul →
+  dequantize-fused epilogue, custom VJP with a bf16 straight-through
+  backward (the ``fused_bn_act``/xentropy kernel pattern: jnp reference
+  as CPU fallback + oracle, ``interpret=True`` for CPU tests);
+* :mod:`.calibrate` — absmax/percentile observation through the
+  telemetry MetricsRegistry, delayed-amax-history freeze, checkpoint
+  round-trip of the frozen scales;
+* :mod:`.layers` — :class:`~apex_tpu.quant.layers.QuantDenseGeneral`,
+  the parameter-compatible dense stand-in the model families'
+  ``quant=`` hook selects (amp opt level **O4** = O2 semantics +
+  these sites quantized);
+* the serving engine's int8 KV cache lives with its substrate in
+  :mod:`apex_tpu.serving.kv_cache` (``cache_dtype=jnp.int8``).
+
+Recipe (docs/quant.md walks it end to end)::
+
+    from apex_tpu import quant
+
+    cal = quant.Calibrator()
+    obs = model_cls(..., quant=quant.QuantConfig.observe())
+    for batch in observation_batches:
+        _, stats = obs.apply({"params": params}, batch,
+                             mutable=["quant_stats"])
+        cal.harvest(jax.device_get(stats["quant_stats"]))
+    calibration = cal.freeze()                    # delayed amax history
+
+    q_model = model_cls(..., quant=quant.QuantConfig.frozen(calibration))
+    init_fn, step_fn = training.make_train_step(loss_fn, tx,
+                                                opt_level="O4")
+"""
+
+from .calibrate import Calibration, Calibrator      # noqa: F401
+from .kernels import (amax_to_scale, channel_scale, dequantize,  # noqa: F401
+                      quantize, quantized_matmul, quantized_matmul_ref,
+                      saturation_count)
+from .layers import QuantConfig, QuantDenseGeneral  # noqa: F401
+
+__all__ = ["Calibration", "Calibrator", "QuantConfig",
+           "QuantDenseGeneral", "amax_to_scale", "channel_scale",
+           "dequantize", "quantize", "quantized_matmul",
+           "quantized_matmul_ref", "saturation_count"]

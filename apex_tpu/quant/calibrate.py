@@ -1,0 +1,235 @@
+"""Scale calibration: observe → freeze → serve (ISSUE 13, layer 2).
+
+int8 activations need a RANGE before the first quantized step: symmetric
+absmax scaling maps ``[-amax, amax]`` onto ``[-127, 127]``, so the whole
+accuracy story is "how good is your amax".  This module implements the
+two standard recipes over the machinery the repo already has:
+
+* **observation phase** — run a handful of real batches through the
+  model in ``mode="observe"`` (the :class:`~apex_tpu.quant.layers.
+  QuantDenseGeneral` sites fold a running absmax into a flax
+  ``quant_stats`` collection; one fetch per batch, at the boundary the
+  caller already owns).  :meth:`Calibrator.harvest` feeds each fetch
+  into a bounded per-site **amax history** and mirrors it into the
+  telemetry :class:`~apex_tpu.telemetry.metrics.MetricsRegistry`
+  (``quant_absmax/<site>`` high-water gauges + ``quant_amax/<site>``
+  histograms), so calibration is observable through the exact same
+  Prometheus export as everything else;
+* **freeze** — :meth:`Calibrator.freeze` collapses each history into
+  one frozen scale: ``mode="max"`` is the delayed-amax-history scaling
+  of FP8 training (Micikevicius et al. — the max over the last H
+  observations, robust to a single quiet batch), ``mode=<percentile>``
+  clips outliers LLM.int8()-style (e.g. ``99.9`` ignores the one-in-a-
+  thousand spike that would otherwise waste the int8 grid on empty
+  range).
+
+The frozen :class:`Calibration` is a plain host object: scales embed in
+the traced step as CONSTANTS (recalibrating means one deliberate
+retrace, never a per-step recompute — jaxlint J014 flags the latter),
+and it serializes through :class:`~apex_tpu.checkpoint.CheckpointManager`
+extras (``state_dict()`` is tagged-JSON-compatible) so a serving process
+restores the exact training-time scales::
+
+    mgr.save(step, state, quant_calibration=calib.state_dict())
+    ...
+    restored = load_checkpoint_dir(d, like=state)
+    calib = Calibration.from_state_dict(restored.extra["quant_calibration"])
+
+At runtime :meth:`Calibration.note_saturation` reports observed
+range overflows into the telemetry stream (``kind="quant"`` events) for
+the ``quant_scale_saturation`` watchdog rule — the "your calibration
+went stale" alarm.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["Calibrator", "Calibration"]
+
+#: the quantized range half-width (mirrors kernels.QMAX without a jax
+#: import — calibration is pure host code).
+_QMAX = 127.0
+
+#: flax collection name the observe-mode layers write into.
+STATS_COLLECTION = "quant_stats"
+
+
+def _active_registry():
+    """The active recorder's MetricsRegistry, or None — calibration
+    mirrors into telemetry only when a run is recording."""
+    from .. import telemetry as _telemetry
+    rec = _telemetry.get_recorder()
+    return rec.metrics if rec is not None else None
+
+
+def _flatten_stats(tree, prefix=()) -> Dict[str, float]:
+    """Flatten a ``quant_stats`` collection (nested dicts of ``amax``
+    leaves) into ``{"block_0/mlp_up": amax_float}`` — the same
+    ``/``-joined naming the layers use for scale lookup."""
+    out: Dict[str, float] = {}
+    if hasattr(tree, "items"):
+        for k, v in tree.items():
+            if k == "amax":
+                out["/".join(str(p) for p in prefix)] = float(v)
+            else:
+                out.update(_flatten_stats(v, prefix + (str(k),)))
+        return out
+    # a bare array leaf (caller passed {"name": amax})
+    out["/".join(str(p) for p in prefix)] = float(tree)
+    return out
+
+
+class Calibration:
+    """Frozen per-site activation scales (the observe phase's output).
+
+    ``scales``: ``{site_name: x_scale}`` (floats, ``amax / 127``);
+    ``amax``: the amax each scale froze from, kept for the saturation
+    check and for human inspection.  ``get``/``x_scale_for`` return
+    None for unknown sites — the layer hook then falls back to the
+    plain (bitwise-O2) dense path, so a missing calibration NEVER
+    changes numerics silently."""
+
+    def __init__(self, scales: Dict[str, float],
+                 amax: Optional[Dict[str, float]] = None,
+                 meta: Optional[dict] = None):
+        self.scales = {str(k): float(v) for k, v in scales.items()}
+        self.amax = {str(k): float(v) for k, v in (amax or {}).items()}
+        self.meta = dict(meta or {})
+        self._saturations: Dict[str, int] = {}
+
+    def x_scale_for(self, name: str) -> Optional[float]:
+        return self.scales.get(name)
+
+    get = x_scale_for
+
+    def __len__(self) -> int:
+        return len(self.scales)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.scales
+
+    def __repr__(self) -> str:
+        return (f"Calibration({len(self.scales)} site(s), "
+                f"mode={self.meta.get('mode')!r})")
+
+    # -- checkpoint round-trip ------------------------------------------------
+    def state_dict(self) -> dict:
+        """JSON-compatible dict for checkpoint ``extra`` round-trip
+        (``CheckpointManager.save(..., quant_calibration=...)``)."""
+        return {"version": 1, "scales": dict(self.scales),
+                "amax": dict(self.amax), "meta": dict(self.meta)}
+
+    @classmethod
+    def from_state_dict(cls, sd: dict) -> "Calibration":
+        if int(sd.get("version", 1)) != 1:
+            raise ValueError(
+                f"unknown quant calibration version {sd.get('version')!r}")
+        return cls(sd.get("scales") or {}, sd.get("amax") or {},
+                   sd.get("meta") or {})
+
+    # -- runtime saturation reporting ----------------------------------------
+    def note_saturation(self, name: str, exceeded: int, *,
+                        window: Optional[int] = None,
+                        recorder=None) -> None:
+        """Report that ``exceeded`` elements (or steps) overflowed the
+        calibrated range for ``name`` in the last observation window
+        (:func:`apex_tpu.quant.kernels.saturation_count` produces the
+        device-side count; fetch it at a boundary you already pay).
+        Emits a ``kind="quant"`` telemetry event the
+        ``quant_scale_saturation`` watchdog rule folds, and bumps the
+        ``quant_saturations/<name>`` counter."""
+        from .. import telemetry as _telemetry
+        exceeded = int(exceeded)
+        self._saturations[name] = self._saturations.get(name, 0) + exceeded
+        rec = recorder if recorder is not None else _telemetry.get_recorder()
+        if rec is None or exceeded <= 0:
+            return
+        rec.event("quant", phase="saturation", name=name,
+                  exceeded=exceeded,
+                  amax=self.amax.get(name),
+                  **({"window": int(window)} if window else {}))
+        rec.metrics.counter(f"quant_saturations/{name}").inc(exceeded)
+
+    @property
+    def saturations(self) -> Dict[str, int]:
+        return dict(self._saturations)
+
+
+class Calibrator:
+    """Bounded amax-history accumulator for the observation phase.
+
+    ``history`` bounds the delayed-amax window (FP8-style: freeze
+    against the max of the last H observations, so one early warmup
+    batch cannot pin the range forever); ``registry`` overrides the
+    telemetry mirror target (defaults to the ACTIVE recorder's
+    MetricsRegistry, a no-op when nothing records)."""
+
+    def __init__(self, *, history: int = 16, registry=None):
+        self.history = max(1, int(history))
+        self._hist: Dict[str, deque] = {}
+        self._registry = registry
+
+    def observe(self, name: str, amax: float) -> None:
+        """Fold one site's observed absmax (a HOST float — fetch device
+        values at a boundary you already pay, e.g. the per-batch stats
+        fetch of the observe phase)."""
+        amax = float(amax)
+        name = str(name)
+        h = self._hist.get(name)
+        if h is None:
+            h = self._hist[name] = deque(maxlen=self.history)
+        h.append(amax)
+        reg = self._registry if self._registry is not None \
+            else _active_registry()
+        if reg is not None:
+            reg.gauge(f"quant_absmax/{name}").set_max(amax)
+            reg.histogram(f"quant_amax/{name}").observe(amax)
+
+    def harvest(self, stats) -> "Calibrator":
+        """Fold one fetched ``quant_stats`` collection (the nested dict
+        ``model.apply(..., mutable=["quant_stats"])`` returns, already
+        device_get'd by the caller) — one :meth:`observe` per site."""
+        for name, amax in _flatten_stats(stats).items():
+            self.observe(name, amax)
+        return self
+
+    @property
+    def sites(self):
+        return sorted(self._hist)
+
+    def freeze(self, mode: Any = "max") -> Calibration:
+        """Collapse each site's history into one frozen scale.
+
+        ``mode="max"``: delayed amax history — the max over the last
+        ``history`` observations (the FP8 recipe; also the safe
+        default).  ``mode=<float percentile>`` (e.g. ``99.9``): the
+        nearest-rank percentile over the history, clipping outlier
+        spikes LLM.int8()-style.
+        """
+        from ..telemetry.metrics import nearest_rank_percentiles
+
+        if not self._hist:
+            raise ValueError(
+                "Calibrator has no observations — run an observation "
+                "phase (mode='observe' + harvest) before freeze()")
+        scales, amaxes = {}, {}
+        for name, h in self._hist.items():
+            vals = list(h)
+            if mode == "max":
+                amax = max(vals)
+            else:
+                q = float(mode)
+                if not 0.0 < q <= 100.0:
+                    raise ValueError(
+                        f"percentile mode must be in (0, 100], got {q}")
+                amax = nearest_rank_percentiles(vals, (q,))[0]
+            amaxes[name] = float(amax)
+            scales[name] = (float(amax) / _QMAX) if amax > 0 else 1.0
+        return Calibration(scales, amaxes,
+                           meta={"mode": str(mode),
+                                 "history": self.history,
+                                 "observations": {
+                                     k: len(v)
+                                     for k, v in self._hist.items()}})

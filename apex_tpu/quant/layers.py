@@ -1,0 +1,200 @@
+"""Model-side quantization hook: drop-in dense layers (ISSUE 13, layer 3).
+
+The amp O4 opt level routes ANNOTATED matmuls through the int8 kernels
+while everything else keeps exact O2 semantics.  The annotation lives
+here: :class:`QuantDenseGeneral` is a parameter-compatible stand-in for
+``nn.Dense`` / ``nn.DenseGeneral`` (same ``kernel``/``bias`` names,
+shapes, AND initializer draws — flax's flat-shape ``lecun_normal`` wrap
+is reproduced exactly, so an O2 checkpoint drops into an O4 model and
+vice versa), selected by the ``quant=`` factory hook the model families
+grew (``models/gpt.py`` / ``models/bert.py`` — the same pattern as PR
+7's ``norm_cls`` ResNet factory).
+
+Three modes, driven by one :class:`QuantConfig`:
+
+========== ==============================================================
+``off``     plain dense math (flax-bitwise — promote_dtype + the same
+            ``dot_general`` dimension numbers)
+``observe`` plain dense math + a running per-site absmax folded into a
+            flax ``quant_stats`` collection (run with
+            ``mutable=["quant_stats"]``; feed each fetch to
+            :meth:`~apex_tpu.quant.calibrate.Calibrator.harvest`)
+``quant``   sites with a frozen calibration scale dispatch
+            :func:`~apex_tpu.quant.kernels.quantized_matmul`; sites
+            WITHOUT one fall back to the plain path — a missing or
+            partial calibration degrades to bitwise O2, never to silent
+            garbage
+========== ==============================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import dtypes as _flax_dtypes
+
+from . import kernels as K
+from .calibrate import STATS_COLLECTION
+
+__all__ = ["QuantConfig", "QuantDenseGeneral"]
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """One quantization policy for a model build.
+
+    ``mode``: ``"off"`` / ``"observe"`` / ``"quant"`` (table in the
+    module docstring); ``scales``: a
+    :class:`~apex_tpu.quant.calibrate.Calibration` or a plain
+    ``{site: x_scale}`` mapping (site names are ``/``-joined module
+    paths, e.g. ``"block_0/mlp_up"``); ``impl``/``interpret`` forward
+    to :func:`~apex_tpu.quant.kernels.quantized_matmul` (tests run the
+    real kernel on CPU via ``interpret=True``)."""
+
+    mode: str = "quant"
+    scales: Any = None
+    impl: Optional[str] = None
+    interpret: bool = False
+
+    def __post_init__(self):
+        if self.mode not in ("off", "observe", "quant"):
+            raise ValueError(f"QuantConfig mode must be 'off', 'observe' "
+                             f"or 'quant', got {self.mode!r}")
+
+    @classmethod
+    def observe(cls) -> "QuantConfig":
+        """The observation-phase config (no scales yet)."""
+        return cls(mode="observe")
+
+    @classmethod
+    def frozen(cls, calibration, **kw) -> "QuantConfig":
+        """A serving/training config over a frozen calibration."""
+        return cls(mode="quant", scales=calibration, **kw)
+
+    def scale_for(self, name: str) -> Optional[float]:
+        s = self.scales
+        if s is None:
+            return None
+        if hasattr(s, "x_scale_for"):
+            return s.x_scale_for(name)
+        return s.get(name)
+
+
+def _tup(v) -> Tuple[int, ...]:
+    return (v,) if isinstance(v, int) else tuple(v)
+
+
+class QuantDenseGeneral(nn.Module):
+    """Parameter-compatible quantized ``nn.Dense``/``nn.DenseGeneral``.
+
+    ``features``/``axis`` follow the flax contract (scalar-or-tuple
+    features; ``axis`` the contracting input dims, default ``-1``);
+    params are created with flax's exact names, shapes, and initializer
+    draws, so swapping this in for the plain module is a checkpoint
+    no-op.  Dispatch per :class:`QuantConfig` mode — see the module
+    docstring."""
+
+    features: Union[int, Tuple[int, ...]]
+    axis: Union[int, Tuple[int, ...]] = -1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    quant: Optional[QuantConfig] = None
+
+    @nn.compact
+    def __call__(self, x):
+        features = _tup(self.features)
+        axis = tuple(a % x.ndim for a in _tup(self.axis))
+        in_shape = tuple(x.shape[a] for a in axis)
+        n_in = 1
+        for s in in_shape:
+            n_in *= s
+        n_out = 1
+        for s in features:
+            n_out *= s
+
+        # flax DenseGeneral draws the kernel on the FLAT (n_in, n_out)
+        # shape and reshapes — reproduce it so init values are bitwise
+        # identical to the module this one replaces.
+        def kernel_init(rng, shape, dtype):
+            flat = nn.initializers.lecun_normal()(rng, (n_in, n_out),
+                                                  dtype)
+            return jnp.reshape(flat, shape)
+
+        kernel = self.param("kernel", kernel_init, in_shape + features,
+                            self.param_dtype)
+        bias = (self.param("bias", nn.initializers.zeros_init(), features,
+                           self.param_dtype)
+                if self.use_bias else None)
+
+        cfg = self.quant if self.quant is not None else QuantConfig("off")
+        site = self._site_name()
+        if cfg.mode == "observe":
+            # running absmax per site; create-only on the init trace
+            # (the has_variable-before-variable pattern of the decode
+            # cache) so the init batch never pollutes the statistics
+            live = self.has_variable(STATS_COLLECTION, "amax")
+            amax = self.variable(STATS_COLLECTION, "amax",
+                                 lambda: jnp.zeros((), jnp.float32))
+            if live:
+                amax.value = jnp.maximum(
+                    amax.value,
+                    jnp.max(jnp.abs(x)).astype(jnp.float32))
+            return self._plain(x, kernel, bias, axis, features)
+        if cfg.mode == "quant":
+            x_scale = cfg.scale_for(site)
+            if x_scale is not None:
+                return self._quantized(x, kernel, bias, axis, features,
+                                       x_scale, cfg)
+        return self._plain(x, kernel, bias, axis, features)
+
+    def _site_name(self) -> str:
+        try:
+            path = self.path
+        except Exception:                       # pragma: no cover - old flax
+            path = self.scope.path if self.scope is not None else ()
+        return "/".join(str(p) for p in path)
+
+    def _plain(self, x, kernel, bias, axis, features):
+        """The exact flax DenseGeneral computation (promote_dtype +
+        the same dot_general dimension numbers + the same bias
+        broadcast) — the bitwise O2 fallback."""
+        x, kernel, bias = _flax_dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype)
+        contract = tuple(range(len(axis)))
+        out = jax.lax.dot_general(x, kernel, ((axis, contract), ((), ())))
+        if bias is not None:
+            out = out + jnp.reshape(
+                bias, (1,) * (out.ndim - len(features)) + features)
+        return out
+
+    def _quantized(self, x, kernel, bias, axis, features, x_scale, cfg):
+        """Flatten to 2-D, run the int8 kernel, restore dims; bias adds
+        in the compute dtype like the plain path."""
+        x, kernel, bias = _flax_dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype)
+        # contracting dims must be trailing for the 2-D flatten; the
+        # model family only uses axis=-1 and axis=(-2, -1), both
+        # already trailing.
+        if axis != tuple(range(x.ndim - len(axis), x.ndim)):
+            return self._plain(x, kernel, bias, axis, features)
+        n_in = 1
+        for a in axis:
+            n_in *= x.shape[a]
+        n_out = 1
+        for s in features:
+            n_out *= s
+        lead = x.shape[:x.ndim - len(axis)]
+        x2d = x.reshape(-1, n_in)
+        k2d = kernel.reshape(n_in, n_out)
+        out = K.quantized_matmul(x2d, k2d, x_scale=x_scale,
+                                 impl=cfg.impl, interpret=cfg.interpret)
+        out = out.reshape(*lead, *features)
+        if bias is not None:
+            out = out + jnp.reshape(
+                bias, (1,) * (out.ndim - len(features)) + features)
+        return out

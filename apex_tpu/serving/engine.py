@@ -176,6 +176,10 @@ class ServingEngine:
             n_pages = 1 + self.max_seqs * (buckets[-1] // page_size)
         self.pool_k, self.pool_v = _kv.make_pool(
             model, n_pages, page_size, dtype=cache_dtype)
+        #: what the pool actually stores ("int8" under the quantized KV
+        #: cache, else the compute dtype) — the Prometheus run-info
+        #: label and the capacity-planning stat ride on it (ISSUE 13).
+        self.kv_cache_dtype = _kv.storage_dtype(self.pool_k)
         self.pages = _kv.PageAllocator(n_pages)
         self._slots: List[Optional[_Active]] = [None] * self.max_seqs
         # per-slot decode state (host): current write position, last
@@ -193,7 +197,9 @@ class ServingEngine:
         self._aot: dict = {}
         self.stats = {"submitted": 0, "completed": 0, "rejected": 0,
                       "aot_misses": 0, "hotswaps": 0, "tokens_out": 0,
-                      "decode_steps": 0, "prefills": 0}
+                      "decode_steps": 0, "prefills": 0,
+                      "kv_bytes_per_token": _kv.kv_bytes_per_token(
+                          model, cache_dtype)}
         self._telemetry = telemetry
         self._t_rate = None                    # tokens/s gauge anchor
         self.watcher: Optional[WeightWatcher] = None
@@ -231,6 +237,11 @@ class ServingEngine:
             sum(1 for s in self._slots if s is not None))
         rec.metrics.gauge("serving_kv_page_occupancy_pct").set(
             self.pages.occupancy_pct)
+        rec.metrics.gauge("serving_kv_bytes_per_token").set(
+            self.stats["kv_bytes_per_token"])
+        # run-info label, not a sample: capacity dashboards slice
+        # tokens/sec and occupancy by the KV storage dtype (ISSUE 13)
+        rec.run_info["kv_cache_dtype"] = self.kv_cache_dtype
 
     # -- bucketed step programs ---------------------------------------------
     def _bucket_for(self, total_len: int) -> Optional[int]:

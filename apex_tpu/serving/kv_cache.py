@@ -33,28 +33,124 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PageAllocator", "make_pool", "gather_views",
-           "scatter_prefill", "scatter_token"]
+__all__ = ["PageAllocator", "QuantPool", "make_pool", "gather_views",
+           "scatter_prefill", "scatter_token", "kv_bytes_per_token",
+           "pages_for_budget", "storage_dtype"]
 
 #: page id 0 is the trash page: dead slots and table padding point at it.
 TRASH_PAGE = 0
 
 
-def make_pool(model, n_pages: int, page_size: int, dtype=None
-              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Zeroed ``(pool_k, pool_v)`` device arrays
-    ``[n_layers, n_pages, page_size, n_kv_heads, head_dim]`` for a
-    :class:`~apex_tpu.models.gpt.GPT` config.  GQA models pool only the
-    kv heads (the cache-bandwidth saving is real at decode, which is
-    bandwidth-bound)."""
+class QuantPool:
+    """One int8 half of the KV pool (ISSUE 13, serving layer).
+
+    Decode is bandwidth-bound and the KV cache IS the bandwidth: int8
+    storage halves the bytes attention streams per token AND halves the
+    HBM a page pins, so the same pool budget admits ~2x the concurrent
+    sequences.  The numerics recipe is per-(token, head) symmetric
+    absmax — ``data`` int8 ``[n_layers, n_pages, page, n_kv, head_dim]``
+    plus ``scale`` fp32 ``[n_layers, n_pages, page, n_kv]`` (one scale
+    per cached row: 4 bytes against ``head_dim`` saved, and the finest
+    granularity the page layout stores for free).  Quantization happens
+    INSIDE :func:`scatter_prefill` / :func:`scatter_token`;
+    :func:`gather_views` dequantizes into the ``out_dtype`` dense views
+    the incremental forward consumes — callers never see int8.
+
+    Registered as a pytree (children ``data``/``scale``), so the pool
+    donates through every serving dispatch exactly like the plain
+    arrays it replaces."""
+
+    def __init__(self, data, scale, out_dtype):
+        self.data = data
+        self.scale = scale
+        self.out_dtype = jnp.dtype(out_dtype)
+
+    @property
+    def shape(self):
+        """The logical (dense-view) pool shape — the plain pool's."""
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        """The DENSE VIEW dtype (what gather_views hands the model);
+        the storage dtype is ``data.dtype`` (int8)."""
+        return self.out_dtype
+
+
+jax.tree_util.register_pytree_node(
+    QuantPool,
+    lambda p: ((p.data, p.scale), str(p.out_dtype)),
+    lambda aux, ch: QuantPool(ch[0], ch[1], aux))
+
+
+def storage_dtype(pool) -> str:
+    """The dtype a pool half actually stores (``"int8"`` for a
+    :class:`QuantPool`) — the ``kv_cache_dtype`` run-info label."""
+    if isinstance(pool, QuantPool):
+        return str(pool.data.dtype)
+    return str(jnp.dtype(pool.dtype))
+
+
+def _model_kv_dims(model) -> Tuple[int, int, int]:
     n_kv = model.num_kv_heads or model.num_heads
-    head_dim = model.hidden_size // model.num_heads
+    return model.num_layers, n_kv, model.hidden_size // model.num_heads
+
+
+def kv_bytes_per_token(model, dtype=None) -> int:
+    """HBM bytes ONE cached token costs across all layers (k + v,
+    scales included for int8) — the ``kv_bytes_per_token`` serving
+    stat."""
+    n_layers, n_kv, head_dim = _model_kv_dims(model)
+    dt = jnp.dtype(model.dtype if dtype is None else dtype)
+    if dt == jnp.dtype(jnp.int8):
+        per_head = head_dim * 1 + 4          # int8 row + one fp32 scale
+    else:
+        per_head = head_dim * dt.itemsize
+    return 2 * n_layers * n_kv * per_head
+
+
+def pages_for_budget(model, page_size: int, budget_bytes: int,
+                     dtype=None) -> int:
+    """How many KV pages fit a byte budget at ``dtype`` storage — the
+    equal-HBM capacity comparison of the bench gate (int8 admits
+    >= 1.5x the pages bf16 does at the same budget)."""
+    per_page = kv_bytes_per_token(model, dtype) * int(page_size)
+    return int(budget_bytes) // per_page if per_page else 0
+
+
+def make_pool(model, n_pages: int, page_size: int, dtype=None):
+    """Zeroed ``(pool_k, pool_v)`` for a
+    :class:`~apex_tpu.models.gpt.GPT` config: plain device arrays
+    ``[n_layers, n_pages, page_size, n_kv_heads, head_dim]``, or
+    :class:`QuantPool` halves when ``dtype`` is ``jnp.int8`` (int8
+    storage + per-row scales; dense views dequantize to the model's
+    compute dtype).  GQA models pool only the kv heads (the
+    cache-bandwidth saving is real at decode, which is
+    bandwidth-bound)."""
+    n_layers, n_kv, head_dim = _model_kv_dims(model)
     dt = model.dtype if dtype is None else dtype
-    shape = (model.num_layers, n_pages, page_size, n_kv, head_dim)
+    shape = (n_layers, n_pages, page_size, n_kv, head_dim)
+    if jnp.dtype(dt) == jnp.dtype(jnp.int8):
+        def half():
+            return QuantPool(jnp.zeros(shape, jnp.int8),
+                             jnp.ones(shape[:-1], jnp.float32),
+                             model.dtype)
+        return half(), half()
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def _quant_rows(x):
+    """Symmetric int8 per-row quantization over the trailing head_dim
+    axis: ``(q int8, scale f32[...])`` — same rounding/zero-amax rules
+    as :mod:`apex_tpu.quant.kernels` (shared helpers)."""
+    from ..quant.kernels import amax_to_scale, quantize
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax_to_scale(amax)
+    return quantize(x, scale[..., None]), scale
 
 
 def gather_views(pool_k, pool_v, tables):
@@ -63,13 +159,22 @@ def gather_views(pool_k, pool_v, tables):
     ``tables``: ``[S, n_pages_b]`` int32 page ids (a bucket-width slice
     of the host page table).  Returns a list of per-layer ``(k, v)``
     pairs, each ``[S, n_pages_b * page_size, n_kv, head_dim]`` — exactly
-    the ``kv_caches`` shape the GPT incremental forward takes."""
+    the ``kv_caches`` shape the GPT incremental forward takes.  An int8
+    pool dequantizes here, INSIDE the jitted step feeding the
+    suffix-aligned flash-attention decode path — the gather reads the
+    (halved) int8 bytes once and the fp path never touches HBM."""
     n_layers, _, page_size, n_kv, head_dim = pool_k.shape
     s, n_pages_b = tables.shape
 
     def dense(pool):
-        g = pool[:, tables]          # [L, S, n_pages_b, page, n_kv, hd]
-        return g.reshape(n_layers, s, n_pages_b * page_size, n_kv,
+        if isinstance(pool, QuantPool):
+            g = pool.data[:, tables]     # [L, S, nb, page, n_kv, hd] i8
+            sc = pool.scale[:, tables]   # [L, S, nb, page, n_kv]
+            d = (g.astype(jnp.float32) * sc[..., None]).astype(
+                pool.out_dtype)
+        else:
+            d = pool[:, tables]          # [L, S, n_pages_b, page, ...]
+        return d.reshape(n_layers, s, n_pages_b * page_size, n_kv,
                          head_dim)
 
     kd, vd = dense(pool_k), dense(pool_v)
@@ -81,9 +186,20 @@ def scatter_prefill(pool, pages, dense):
 
     ``pages``: ``[n_pages_b]`` int32; ``dense``: ``[n_layers, bucket,
     n_kv, head_dim]`` (the batch-1 view the prefill forward produced).
-    Page-granular scatter: one ``.at[].set`` over the page axis."""
+    Page-granular scatter: one ``.at[].set`` over the page axis.  An
+    int8 pool quantizes per (token, head) on the way in."""
     n_layers, _, page_size, n_kv, head_dim = pool.shape
-    paged = dense.reshape(n_layers, pages.shape[0], page_size, n_kv,
+    n_pages_b = pages.shape[0]
+    if isinstance(pool, QuantPool):
+        q, sc = _quant_rows(dense)
+        return QuantPool(
+            pool.data.at[:, pages].set(
+                q.reshape(n_layers, n_pages_b, page_size, n_kv,
+                          head_dim)),
+            pool.scale.at[:, pages].set(
+                sc.reshape(n_layers, n_pages_b, page_size, n_kv)),
+            pool.out_dtype)
+    paged = dense.reshape(n_layers, n_pages_b, page_size, n_kv,
                           head_dim)
     return pool.at[:, pages].set(paged.astype(pool.dtype))
 
@@ -93,7 +209,14 @@ def scatter_token(pool, page_ids, offsets, tok):
 
     ``page_ids``/``offsets``: ``[S]`` int32 (page and in-page offset of
     each slot's current position — dead slots point at the trash page);
-    ``tok``: ``[n_layers, S, n_kv, head_dim]``."""
+    ``tok``: ``[n_layers, S, n_kv, head_dim]``.  An int8 pool
+    quantizes per (token, head) on the way in."""
+    if isinstance(pool, QuantPool):
+        q, sc = _quant_rows(tok)
+        return QuantPool(
+            pool.data.at[:, page_ids, offsets].set(q),
+            pool.scale.at[:, page_ids, offsets].set(sc),
+            pool.out_dtype)
     return pool.at[:, page_ids, offsets].set(tok.astype(pool.dtype))
 
 

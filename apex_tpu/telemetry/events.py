@@ -254,6 +254,10 @@ class Recorder:
         self._bytes_written = 0
         self._segment = 0
         self._meta = dict(meta or {})
+        #: free-form identity labels merged into the Prometheus
+        #: ``run_info`` exposition (e.g. the serving engine's
+        #: ``kv_cache_dtype`` — ISSUE 13).  Last-write-wins strings.
+        self.run_info: Dict[str, str] = {}
         self._closed = False
         self._counts: Dict[str, int] = {}
         #: host-side instruments, snapshotted into the ``summary`` event.

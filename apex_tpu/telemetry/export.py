@@ -71,9 +71,18 @@ def sanitize_name(name: str) -> str:
     return name
 
 
+def _label_value(v) -> str:
+    """Escape a label VALUE per the exposition format (backslash, double
+    quote, newline) — run_info values are free-form caller strings and
+    one bad character would invalidate the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _line(name: str, value, labels: Optional[Dict[str, str]] = None) -> str:
     if labels:
-        lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        lab = ",".join(f'{k}="{_label_value(v)}"'
+                       for k, v in sorted(labels.items()))
         return f"{name}{{{lab}}} {_num(value)}"
     return f"{name} {_num(value)}"
 
@@ -111,6 +120,9 @@ def render(recorder) -> str:
     info_labels = {"run_id": recorder.run_id,
                    "process_index": str(recorder.process_index),
                    "process_count": str(recorder.process_count)}
+    # free-form identity labels (e.g. serving kv_cache_dtype, ISSUE 13)
+    for k, v in sorted((getattr(recorder, "run_info", None) or {}).items()):
+        info_labels.setdefault(sanitize_name(str(k)), str(v))
     lines.append(f"# TYPE {NAMESPACE}_run_info gauge")
     lines.append(_line(f"{NAMESPACE}_run_info", 1, info_labels))
     for name, value in sorted((snap.get("counters") or {}).items()):

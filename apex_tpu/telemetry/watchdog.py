@@ -54,6 +54,12 @@ Rules (each a small stateful fold; thresholds are constructor kwargs):
                           ``serving_stall_s`` — requests are aging in the
                           queue faster than decode slots/KV pages free up
                           (ISSUE 11: the inference twin of loader_stall)
+``quant_scale_saturation``  ``quant`` saturation events report more than
+                          ``quant_max_exceeded`` range overflows within one
+                          observation window — the calibrated absmax has
+                          gone stale (activations drifted past the frozen
+                          int8 range) and the quantizer is clipping;
+                          re-observe and re-freeze (ISSUE 13)
 ========================  =====================================================
 
 Usage — the examples' ``--watchdog`` flag does exactly this::
@@ -76,7 +82,8 @@ __all__ = ["Watchdog", "attach", "RULE_NAMES"]
 
 RULE_NAMES = ("nonfinite", "scale_collapse", "loader_stall", "step_time",
               "retrace_storm", "checkpoint_stall", "checkpoint_failed",
-              "memory_headroom", "serving_queue_stall")
+              "memory_headroom", "serving_queue_stall",
+              "quant_scale_saturation")
 
 
 class _Rule:
@@ -386,6 +393,41 @@ class _ServingQueueStall(_Rule):
                            f"pages; add capacity or shed load"}
 
 
+class _QuantScaleSaturation(_Rule):
+    """The int8 engine's staleness alarm (ISSUE 13): frozen calibration
+    scales are a bet that the observed activation range keeps holding.
+    :meth:`apex_tpu.quant.calibrate.Calibration.note_saturation` emits a
+    ``quant`` event whenever fetched runtime absmax checks find the
+    range exceeded; this rule fires when one window's ``exceeded``
+    count passes ``quant_max_exceeded`` — isolated single clips are the
+    normal tail LLM.int8()-style percentile calibration accepts, a
+    burst means the distribution moved and accuracy is silently
+    degrading.  Warning severity: the run is still numerically valid
+    (clipping, not NaN), the fix is a re-observation pass."""
+
+    name = "quant_scale_saturation"
+
+    def __init__(self, quant_max_exceeded: int = 4):
+        self.quant_max_exceeded = int(quant_max_exceeded)
+
+    def observe(self, event):
+        if event.get("kind") != "quant" \
+                or event.get("phase") != "saturation":
+            return None
+        exceeded = int(event.get("exceeded", 0) or 0)
+        if exceeded <= self.quant_max_exceeded:
+            return None
+        name = event.get("name", "?")
+        win = event.get("window")
+        return {"step": event.get("step"), "value": exceeded,
+                "message": f"quant site {name!r} exceeded its calibrated "
+                           f"absmax {exceeded} times"
+                           f"{f' in {win} steps' if win else ''} "
+                           f"(> {self.quant_max_exceeded}) — the frozen "
+                           f"int8 range is stale; re-observe and "
+                           f"re-freeze the calibration"}
+
+
 class Watchdog:
     """Folds recorder events through the rule set and emits debounced
     ``alert`` events back into the same stream.
@@ -424,6 +466,9 @@ class Watchdog:
                 _ServingQueueStall(
                     serving_stall_s=thresholds.get(
                         "serving_stall_s", 2.0)),
+                _QuantScaleSaturation(
+                    quant_max_exceeded=thresholds.get(
+                        "quant_max_exceeded", 4)),
             ]
         self.rules = rules
         self.alerts: List[Dict[str, Any]] = []

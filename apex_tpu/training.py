@@ -15,6 +15,10 @@ opt-level semantics as ``amp.initialize``:
   the master-weights design with zero duplicate storage, the TPU-first
   answer to ``_process_optimizer``'s master machinery.
 * O3: params stored bf16, no masters.
+* O4: EXACTLY O2's storage/scaling semantics; the int8 matmul routing is
+  a property of the MODEL (the ``quant=`` hook of ``apex_tpu.models`` +
+  ``apex_tpu.quant``, ISSUE 13) — a model without frozen calibration
+  runs bitwise as O2.
 
 Step skipping is a device-side select (``apply_mask``), so dynamic loss
 scaling costs no host sync at all (the reference pays one D2H per step,
@@ -163,7 +167,7 @@ def novograd(lr=1e-3, *, bucketed=False, **kw) -> FunctionalOptimizer:
 
 class TrainState(NamedTuple):
     """Carry of the jitted step.  ``params`` is the single source of truth:
-    fp32 for O0/O1/O2 (O2 casts inside the step), bf16 for O3."""
+    fp32 for O0/O1/O2/O4 (O2/O4 cast inside the step), bf16 for O3."""
     params: Any
     opt_state: Any
     scaler: LossScalerState

@@ -1522,6 +1522,174 @@ def _pct(sorted_vals, q):
     return nearest_rank_percentiles(sorted_vals, (q,))[0]
 
 
+def _bench_quant(on_tpu):
+    """ISSUE 13 self-validation: the int8 engine's three acceptance
+    surfaces, measured on whatever backend runs the bench:
+
+    * **matmul probe** — the calibrated :func:`quantized_matmul` vs the
+      bf16 ``jnp.dot`` at a projection-sized shape.  main() gates
+      ``o4_over_bf16 <= 1.0`` ON CHIP only (the MXU's int8 path is the
+      2x; the CPU jnp fallback pays quantize/dequant with no int8 MAC
+      rate to buy it back and is reported, not gated).
+    * **LM step probe** — ms/step of the convergence harness's small
+      GPT at O2 vs O4 (same model, same data, quantized sites the only
+      difference), compile excluded (:func:`_time_steps` warmup).
+    * **int8 KV capacity** — pages the pool admits at the SAME HBM
+      budget under bf16 vs int8 storage (scales included), plus
+      tokens/sec of a real closed-loop generate on both engines.
+      Backend-independent gates in main(): capacity ratio >= 1.5 and
+      the int8-KV engine completes its load bitwise-greedy with zero
+      AOT misses.
+    * the committed **CONVERGENCE_QUANT.json** gate file (O4 tracks O2
+      on the LM trajectory) — present and green, re-read here so the
+      bench fails loudly if the artifact regresses or goes missing.
+    """
+    import jax.random as jrandom
+
+    from apex_tpu import quant
+    from apex_tpu.models import gpt_tiny
+    from apex_tpu import serving
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    import convergence_quant as cq
+
+    out = {}
+
+    # -- matmul probe: calibrated int8 vs the bf16 dot --------------------
+    m, k, n = (8192, 4096, 4096) if on_tpu else (2048, 512, 512)
+    key = jrandom.PRNGKey(0)
+    x = (jrandom.normal(key, (m, k), jnp.float32)).astype(jnp.bfloat16)
+    w = (jrandom.normal(jrandom.PRNGKey(1), (k, n), jnp.float32) * 0.05
+         ).astype(jnp.bfloat16)
+    x_scale = float(np.abs(np.asarray(x, np.float32)).max() / 127.0)
+
+    bf16_mm = jax.jit(lambda a, b: jnp.dot(a, b))
+    q_mm = jax.jit(functools.partial(quant.quantized_matmul,
+                                     x_scale=x_scale))
+
+    def _mm_ms(fn):
+        jax.block_until_ready(fn(x, w))            # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = fn(x, w)
+            jax.block_until_ready(r)  # jaxlint: disable=J001 -- timing fence: the probe must block until the last matmul completes
+            best = min(best, (time.perf_counter() - t0) / 10)
+        return best * 1e3
+
+    t_bf16, t_q = _mm_ms(bf16_mm), _mm_ms(q_mm)
+    out["matmul"] = {
+        "shape": [m, k, n],
+        "bf16_ms": round(t_bf16, 3),
+        "o4_ms": round(t_q, 3),
+        "o4_over_bf16": round(t_q / t_bf16, 3) if t_bf16 > 0 else None,
+    }
+
+    # -- LM step probe: O2 vs O4 ms/step on the convergence model ---------
+    steps = 12 if on_tpu else 6
+
+    def _lm_ms(opt_level):
+        from apex_tpu import training
+        from apex_tpu.training import make_train_step
+        batches = cq.make_lm_dataset(8, 8, 32, 64)
+        params = cq.build_model(None, vocab=64).init(
+            jrandom.PRNGKey(0), jnp.asarray(batches[0][:, :-1]))["params"]
+        if opt_level == "O4":
+            calib = cq.calibrate(params, batches, vocab=64)
+            model = cq.build_model(quant.QuantConfig.frozen(calib),
+                                   vocab=64)
+        else:
+            model = cq.build_model(None, vocab=64)
+
+        def loss_fn(p, b):
+            logits = model.apply({"params": p}, b[:, :-1])
+            logp = jax.nn.log_softmax(
+                logits.reshape(-1, 64).astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(
+                logp, b[:, 1:].reshape(-1)[:, None], axis=1))
+
+        init_fn, step_fn = make_train_step(loss_fn, training.adam(3e-3),
+                                           opt_level=opt_level,
+                                           loss_scale="dynamic")
+        step = jax.jit(step_fn, donate_argnums=(0,))
+        sec, _ = _time_steps(step, init_fn(params),
+                             jnp.asarray(batches[0]), steps)
+        return sec * 1e3
+
+    out["lm_ms_per_step_o2"] = round(_lm_ms("O2"), 3)
+    out["lm_ms_per_step_o4"] = round(_lm_ms("O4"), 3)
+
+    # -- int8 KV: equal-HBM capacity + tokens/sec on a real load ----------
+    model = gpt_tiny(max_len=128)
+    page = 8
+    budget = 64 * 1024 * 1024
+    cap_bf16 = serving.kv_cache.pages_for_budget(model, page, budget,
+                                                 jnp.bfloat16)
+    cap_int8 = serving.kv_cache.pages_for_budget(model, page, budget,
+                                                 jnp.int8)
+    rs = np.random.RandomState(0)
+    probe = jnp.asarray(rs.randint(1, 1024, (1, 8)))
+    params = model.init(jrandom.PRNGKey(1), probe)["params"]
+    prompts = [rs.randint(1, 1024, (int(ln),)).astype(np.int32)
+               for ln in rs.randint(4, 24, 8)]
+
+    def _tokens_per_s(cache_dtype):
+        eng = serving.ServingEngine(model, params, buckets=(32,),
+                                    page_size=page, max_seqs=4,
+                                    cache_dtype=cache_dtype)
+        try:
+            eng.warmup()
+            t0 = time.perf_counter()
+            res = eng.generate(prompts, max_new_tokens=8)
+            wall = time.perf_counter() - t0
+            toks = [tuple(np.asarray(r.tokens).tolist()) for r in res]
+            return {
+                "tokens_per_s": round(
+                    int(eng.stats["tokens_out"]) / wall, 2),
+                "kv_bytes_per_token": eng.stats["kv_bytes_per_token"],
+                "kv_cache_dtype": eng.kv_cache_dtype,
+                "aot_misses": int(eng.stats["aot_misses"]),
+            }, toks
+        finally:
+            eng.close()
+
+    srv_ref, toks_ref = _tokens_per_s(None)
+    srv_int8, toks_int8 = _tokens_per_s(jnp.int8)
+    agree = sum(a == b for a, b in zip(toks_ref, toks_int8))
+    out["kv"] = {
+        "page_size": page,
+        "budget_mb": budget // (1024 * 1024),
+        "pages_bf16": cap_bf16,
+        "pages_int8": cap_int8,
+        "capacity_ratio": (round(cap_int8 / cap_bf16, 3)
+                           if cap_bf16 else None),
+        "serving_ref": srv_ref,
+        "serving_int8": srv_int8,
+        "token_agreement": f"{agree}/{len(prompts)}",
+        "int8_aot_misses": srv_int8["aot_misses"],
+    }
+
+    # -- the committed convergence gate file ------------------------------
+    art_path = os.path.join(root, "CONVERGENCE_QUANT.json")
+    try:
+        with open(art_path) as f:
+            art = json.load(f)
+        v = art.get("verdict", {})
+        out["convergence"] = {
+            "file": "CONVERGENCE_QUANT.json", "ok": bool(v.get("ok")),
+            "rel_tail_gap": v.get("rel_tail_gap"),
+            "track_tol": v.get("track_tol"),
+            "steps": art.get("config", {}).get("steps"),
+        }
+    except (OSError, ValueError) as e:
+        out["convergence"] = {"file": "CONVERGENCE_QUANT.json",
+                              "ok": False,
+                              "error": f"{type(e).__name__}: {e}"}
+    return out
+
+
 def _bench_examples(on_tpu):
     """Execute the flagship example entry points and distill their own
     printed metrics.  Gates: the run completed, every printed loss is
@@ -2323,6 +2491,44 @@ def main():
             f"leaks pages on eviction and a long-running server would "
             f"strand its whole pool; refusing to report.")
 
+    # int8 engine self-validation (ISSUE 13): equal-HBM KV capacity and
+    # the committed convergence artifact are backend-independent gates;
+    # the matmul speedup is a chip property (the CPU jnp fallback pays
+    # quantize/dequant with no int8 MAC rate to buy it back) and gates
+    # on TPU only.
+    extra["quant"] = qnt = _bench_quant(on_tpu)
+    if not qnt["convergence"]["ok"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: the CONVERGENCE_QUANT gate file "
+            f"is missing or red ({qnt['convergence']}) — O4 no longer "
+            f"tracks O2 on the LM trajectory (or the artifact was never "
+            f"recorded); rerun tools/convergence_quant.py; refusing to "
+            f"report.")
+    if qnt["kv"]["capacity_ratio"] is None \
+            or qnt["kv"]["capacity_ratio"] < 1.5:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: int8 KV storage admits only "
+            f"{qnt['kv']['capacity_ratio']}x the pages bf16 does at the "
+            f"same HBM budget (gate >= 1.5x) — the per-row scale "
+            f"overhead outgrew the int8 saving or the byte accounting "
+            f"broke; refusing to report.")
+    if qnt["kv"]["int8_aot_misses"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: the int8-KV serving load paid "
+            f"{qnt['kv']['int8_aot_misses']} compile(s) after warmup — "
+            f"the QuantPool pytree is perturbing the AOT signature; "
+            f"steady-state quantized serving must pay ZERO compiles; "
+            f"refusing to report.")
+    if on_tpu and qnt["matmul"]["o4_over_bf16"] is not None \
+            and qnt["matmul"]["o4_over_bf16"] > 1.0:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: the calibrated int8 matmul ran "
+            f"{qnt['matmul']['o4_over_bf16']}x the bf16 dot on the "
+            f"{qnt['matmul']['shape']} probe — the quantized kernel "
+            f"must not be SLOWER than what it replaces on chip "
+            f"(dequant epilogue unfused, or the dispatch gate routed a "
+            f"probe-sized matmul to jnp); refusing to report.")
+
     # Self-validation, same contract as the MFU gates above: a steady
     # rate far below the example's own best window means the hot loop is
     # stalling on dispatch/syncs again (the exact regression class the
@@ -2518,6 +2724,14 @@ def main():
             "serving_tokens_per_s": extra["serving"].get("tokens_per_s"),
             "serving_p99_latency_ms": (
                 extra["serving"].get("p99_latency_ms")),
+            "quant_matmul_o4_over_bf16": (
+                extra["quant"]["matmul"].get("o4_over_bf16")),
+            "quant_lm_ms_per_step_o4": (
+                extra["quant"].get("lm_ms_per_step_o4")),
+            "quant_kv_capacity_ratio": (
+                extra["quant"]["kv"].get("capacity_ratio")),
+            "quant_serving_tokens_per_s_int8kv": (
+                extra["quant"]["kv"]["serving_int8"].get("tokens_per_s")),
             "telemetry_overhead_ratio": (
                 extra["telemetry"].get("overhead_ratio")),
             "telemetry_step_p50_ms": (

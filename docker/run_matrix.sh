@@ -46,7 +46,11 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # the mesh frontend (ISSUE 12): the ZeRO-2/3 sharding engine is pure
 # XLA collectives over the flat-bucket store, so every tier must hold
 # the bitwise zero1-parity and 1/N state-sharding contracts.
-FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py tests/test_serving.py tests/test_mesh.py -q -m 'not slow'"
+# test_quant.py rides for the int8 engine (ISSUE 13): the pallas tiers
+# run the REAL quantized-matmul kernel via interpret=True, the
+# no-pallas tiers the jnp reference — every tier must hold the
+# kernel-parity, O4-fallback-bitwise-O2, and int8-KV decode contracts.
+FAST="python -m pytest tests/test_install_matrix.py tests/test_multi_tensor.py tests/test_telemetry.py tests/test_roofline.py tests/test_watchdog.py tests/test_contrib.py tests/test_fused_bn_act.py tests/test_cache.py tests/test_checkpoint.py tests/test_faultinject.py tests/test_fleet.py tests/test_export.py tests/test_memory.py tests/test_serving.py tests/test_mesh.py tests/test_quant.py -q -m 'not slow'"
 
 echo "=== tier 1: full (native + pallas) ==="
 python setup.py build_native

@@ -49,8 +49,9 @@ def test_properties_rejects_inconsistent_combos():
 
 
 def test_initialize_rejects_bad_opt_level():
+    # O4 became a real level in ISSUE 13; O5 is the next unknown one
     with pytest.raises(AmpOptionError):
-        amp.initialize(opt_level="O4")
+        amp.initialize(opt_level="O5")
     with pytest.raises(AmpOptionError):
         amp.initialize(opt_level="02")  # zero-two, the classic typo
 

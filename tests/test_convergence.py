@@ -107,6 +107,16 @@ def test_convergence_artifact_if_present():
 
     for path in arts:
         art = json.loads(path.read_text())
+        if art.get("kind") == "quant":
+            # O4-vs-O2 artifact (tools/convergence_quant.py): recompute
+            # the gate from the shipped curves under the artifact's own
+            # tolerance (a stale ok flag must not pass).
+            assert art["verdict"]["ok"], (path.name, art["verdict"])
+            recomputed = gate(art["losses_o2"], art["losses_o4"],
+                              track_tol=art["verdict"]["track_tol"])
+            assert recomputed["ok"], (path.name, recomputed)
+            assert len(art["losses_o4"]) == art["config"]["steps"]
+            continue
         if "verdicts" in art:
             # sharded-topology artifact (tools/convergence_sharded.py):
             # different schema — every topology verdict must be green AND

@@ -112,6 +112,20 @@ def test_render_sanitizes_names(tmp_path):
     rec.close()
 
 
+def test_run_info_label_values_escape(tmp_path):
+    # run_info values are free-form caller strings (ISSUE 13 review):
+    # quotes/backslashes/newlines must escape per the exposition format
+    # or one bad label invalidates the whole scrape
+    rec = telemetry.start(str(tmp_path / "r.jsonl"))
+    rec.run_info["kv_cache_dtype"] = "int8"
+    rec.run_info["build"] = 'rev "dirty"\\x\n'
+    text = tel_export.render(rec)
+    assert 'kv_cache_dtype="int8"' in text
+    assert 'build="rev \\"dirty\\"\\\\x\\n"' in text
+    assert 'rev "dirty"' not in text          # raw value never leaks
+    rec.close()
+
+
 def test_watchdog_alerts_render(tmp_path):
     rec = telemetry.start(str(tmp_path / "r.jsonl"), watchdog=True)
     # a memory event under the headroom floor fires the new rule

@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J013 a known-bad snippet
+1. fixture self-tests — for every rule J001-J014 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -1235,5 +1235,140 @@ def test_j013_is_advisory_and_waivable():
         mesh = Mesh(jax.devices(), ("data",))
         params = jax.device_put(params)  # jaxlint: disable=J013 -- single-host tool, placement irrelevant
         return mesh
+    """
+    assert _codes(waived) == []
+
+
+# -- J014: per-step recalibration at quantized-matmul call sites (ISSUE 13) ---
+
+def test_j014_flags_inline_absmax_scale():
+    bad = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def step_fn(state, batch):
+        x = batch["x"]
+        return quant.quantized_matmul(
+            x, state["w"], x_scale=jnp.max(jnp.abs(x)) / 127.0)
+    """
+    assert _codes(bad) == ["J014"]
+
+
+def test_j014_flags_local_assigned_absmax_and_method_form():
+    bad = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def step_fn(state, batch):
+        x = batch["x"]
+        s = jnp.abs(x).max() / 127.0
+        return quant.quantized_matmul(x, state["w"], x_scale=s)
+    """
+    assert _codes(bad) == ["J014"]
+
+
+def test_j014_frozen_scale_and_w_scale_pass():
+    ok = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def step_fn(state, batch, calib):
+        x = batch["x"]
+        frozen = calib.scales["mlp_up"]
+        a = quant.quantized_matmul(x, state["w"], x_scale=frozen)
+        # w_scale from the CURRENT weights is the correct recipe —
+        # weights are exact at trace time (never J014)
+        b = quant.quantized_matmul(
+            x, state["w"], x_scale=frozen,
+            w_scale=jnp.max(jnp.abs(state["w"]), axis=0) / 127.0)
+        return a + b
+    """
+    assert _codes(ok) == []
+
+
+def test_j014_only_fires_on_quant_call_sites():
+    ok = """
+    import jax.numpy as jnp
+
+    def step_fn(x, w):
+        # an absmax that is NOT a quantized-matmul scale arg is fine
+        norm = jnp.max(jnp.abs(x))
+        return some_op(x, scale=jnp.max(jnp.abs(x)))
+    """
+    assert _codes(ok) == []
+
+
+def test_j014_nested_helper_names_do_not_leak():
+    """A nested helper's local fresh-absmax name must not mark the
+    ENCLOSING function's same-named frozen constant as fresh (review:
+    ast.walk descended into nested defs); the helper's own call still
+    flags in its own scope."""
+    ok = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def outer(state, batch, calib):
+        def helper(x):
+            s = jnp.abs(x).max() / 127.0
+            return s
+        s = calib.scales["mlp_up"]       # frozen — shares the name only
+        return quant.quantized_matmul(batch["x"], state["w"], x_scale=s)
+    """
+    assert _codes(ok) == []
+    bad = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def outer(state, batch, calib):
+        def helper(x):
+            s = jnp.abs(x).max() / 127.0
+            return quant.quantized_matmul(x, state["w"], x_scale=s)
+        frozen = calib.scales["mlp_up"]
+        a = quant.quantized_matmul(batch["x"], state["w"], x_scale=frozen)
+        return a + helper(batch["x"])
+    """
+    assert _codes(bad) == ["J014"]       # the helper's OWN site, once
+
+
+def test_j014_resolution_is_binding_order_aware():
+    """The LAST assignment before the call site decides freshness
+    (review): a name rebound from a fresh absmax to a frozen constant
+    resolves frozen — and the reverse order still flags."""
+    ok = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def step_fn(state, batch, calib):
+        x = batch["x"]
+        s = jnp.max(jnp.abs(x)) / 127.0       # used for clipping only
+        clipped = jnp.clip(x, -s * 127.0, s * 127.0)
+        s = calib.scales["mlp_up"]            # rebound to the constant
+        return quant.quantized_matmul(clipped, state["w"], x_scale=s)
+    """
+    assert _codes(ok) == []
+    bad = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def step_fn(state, batch, calib):
+        x = batch["x"]
+        s = calib.scales["mlp_up"]
+        s = jnp.max(jnp.abs(x)) / 127.0       # rebound to FRESH
+        return quant.quantized_matmul(x, state["w"], x_scale=s)
+    """
+    assert _codes(bad) == ["J014"]
+
+
+def test_j014_is_advisory_and_waivable():
+    from tools.jaxlint.linter import Finding
+
+    assert Finding("p", 1, 0, "J014", "m").advisory
+    waived = """
+    import jax.numpy as jnp
+    from apex_tpu import quant
+
+    def step_fn(state, batch):
+        x = batch["x"]
+        return quant.quantized_matmul(x, state["w"], x_scale=jnp.max(jnp.abs(x)) / 127.0)  # jaxlint: disable=J014 -- sanctioned dynamic-range probe for the calibration sweep
     """
     assert _codes(waived) == []

@@ -297,6 +297,102 @@ def test_zero3_accum_steps_applies_view_transpose():
     assert np.all(np.isfinite(acc_losses)) and acc_losses[-1] < acc_losses[0]
 
 
+# -- ZeRO-3 bf16 gather (ISSUE 13 satellite) ----------------------------------
+
+def _run_mesh_gather(gather_dtype, steps=STEPS):
+    params, x, y = _setup()
+    plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+    ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                zero=3, opt_level="O2",
+                                loss_scale="dynamic",
+                                gather_dtype=gather_dtype)
+    state = ms.init(params)
+    step = ms.jit_step(state, donate=False)
+    batch = plan.device_put_batch((x, y))
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, batch)
+        losses.append(float(jnp.ravel(m["loss"])[0]))
+    return np.asarray(losses), jax.device_get(ms.gather_params(state))
+
+
+def test_zero3_fp32_gather_path_stays_bitwise():
+    """gather_dtype=None (the default) is the exact pre-existing wire:
+    bitwise-equal to the zero1(bucketed=True) baseline."""
+    base_losses, base_params = _run_zero1_baseline()
+    losses, params = _run_mesh_gather(None)
+    np.testing.assert_array_equal(base_losses, losses)
+    for k in base_params:
+        np.testing.assert_array_equal(np.asarray(base_params[k]),
+                                      np.asarray(params[k]))
+
+
+def test_zero3_bf16_gather_tracks_fp32():
+    """The bf16 wire halves gather/scatter bytes; under O2 the compute
+    cast was shipping bf16 into the matmuls anyway, so the trajectory
+    TRACKS the fp32-wire run (tolerance, not bitwise — the weight
+    rounding moves one op earlier) and still learns."""
+    ref_losses, ref_params = _run_mesh_gather(None)
+    losses, params = _run_mesh_gather(jnp.bfloat16)
+    assert losses[-1] < losses[0]
+    np.testing.assert_allclose(losses, ref_losses, rtol=0.05, atol=5e-3)
+    for k in ref_params:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   np.asarray(ref_params[k]),
+                                   rtol=0.05, atol=5e-3)
+
+
+def test_zero3_bf16_gather_halves_wire_bytes(tmp_path):
+    """Per-axis collective-bytes assertion: the fsdp all_gather AND its
+    transpose reduce_scatter are noted with bf16 dtype at HALF the
+    fp32 run's bytes; the dp psum is untouched."""
+    import json
+
+    from apex_tpu import telemetry
+
+    def collect(gather_dtype, path):
+        params, x, y = _setup()
+        plan = M.MeshPlan(dp=2, fsdp=4, devices=jax.devices("cpu")[:8])
+        ms = M.make_mesh_train_step(_loss_fn, training.adam(1e-2), plan,
+                                    zero=3, opt_level="O2",
+                                    gather_dtype=gather_dtype)
+        rec = telemetry.start(path)
+        try:
+            state = ms.init(params)
+            step = ms.jit_step(state, donate=False)
+            state, m = step(state, plan.device_put_batch((x, y)))
+            jax.block_until_ready(m["loss"])
+        finally:
+            rec.close()
+        events = [json.loads(l) for l in open(path) if l.strip()]
+        out = {}
+        for e in events:
+            if e.get("kind") != "collective":
+                continue
+            key = (e["op"], e["axis"] if isinstance(e["axis"], str)
+                   else tuple(e["axis"]))
+            out[key] = out.get(key, 0) + e["bytes"] * e["n"]
+        dts = {e.get("dtype") for e in events
+               if e.get("kind") == "collective"
+               and e.get("op") in ("all_gather", "reduce_scatter")}
+        return out, dts
+
+    fp32, dt32 = collect(None, str(tmp_path / "fp32.jsonl"))
+    bf16, dt16 = collect(jnp.bfloat16, str(tmp_path / "bf16.jsonl"))
+    assert bf16[("all_gather", "fsdp")] * 2 == fp32[("all_gather", "fsdp")]
+    assert (bf16[("reduce_scatter", "fsdp")] * 2
+            == fp32[("reduce_scatter", "fsdp")])
+    assert bf16[("psum", "dp")] == fp32[("psum", "dp")]
+    assert dt32 == {"float32"} and dt16 == {"bfloat16"}
+
+
+def test_gather_dtype_rejected_below_zero3():
+    plan = M.MeshPlan(dp=1, fsdp=8, devices=jax.devices("cpu")[:8])
+    with pytest.raises(ValueError, match="gather_dtype"):
+        M.make_mesh_train_step(_loss_fn, training.adam(1e-3), plan,
+                               zero=2, gather_dtype=jnp.bfloat16)
+
+
 # -- contracts & rejections ---------------------------------------------------
 
 def test_zero_sharded_rejects_per_tensor_norm_optimizers():

@@ -1,0 +1,605 @@
+"""apex_tpu.quant — the int8 low-precision engine (ISSUE 13).
+
+Acceptance contracts under test:
+
+* kernel parity matrix: the REAL Pallas kernel (interpret mode) against
+  the jnp reference, forward AND backward, per-tensor + per-channel
+  scales, incl. the zero-amax-channel corner;
+* the model hook: O4 with an empty/missing calibration is BITWISE O2
+  (never silent degradation), a frozen calibration quantizes only the
+  calibrated sites;
+* calibration lifecycle: observe → freeze → checkpoint-extra round-trip
+  (the serving restore path);
+* O4-vs-O2 convergence tolerance on the small LM (the CI-scale twin of
+  CONVERGENCE_QUANT.json);
+* int8 KV cache: scatter/gather round-trip within quantization
+  tolerance, decode parity vs the full-precision pool, hot-swap bitwise
+  stability, and the >= 1.5x equal-HBM page-capacity claim;
+* zero steady-state retraces of the quantized step under
+  ``StepPipeline.warmup`` (trace-count pinned).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from apex_tpu import quant, runtime, serving, training  # noqa: E402
+from apex_tpu.models.gpt import gpt_tiny  # noqa: E402
+from apex_tpu.prof import assert_trace_count  # noqa: E402
+from apex_tpu.quant import kernels as QK  # noqa: E402
+from apex_tpu.serving import kv_cache as KV  # noqa: E402
+from apex_tpu.training import make_train_step  # noqa: E402
+
+
+# -- kernel parity matrix -----------------------------------------------------
+
+def _operands(m, k, n, dtype, seed=0, zero_channel=None):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(m, k), dtype)
+    w = np.asarray(rs.randn(k, n) / np.sqrt(k), np.float32)
+    if zero_channel is not None:
+        w[:, zero_channel] = 0.0
+    w = jnp.asarray(w, dtype)
+    xs = float(np.abs(np.asarray(x, np.float32)).max()) / 127.0
+    return x, w, xs
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("m,k,n", [(32, 64, 48), (17, 96, 130), (8, 8, 8)])
+def test_kernel_fwd_interpret_matches_reference(dtype, m, k, n):
+    """The REAL kernel (interpret=True) against the jnp reference —
+    quantize, int8 dot, dequant epilogue are op-identical, so the
+    parity is exact, including ragged M/N blocks."""
+    x, w, xs = _operands(m, k, n, dtype)
+    ref = quant.quantized_matmul_ref(x, w, x_scale=xs)
+    out = quant.quantized_matmul(x, w, x_scale=xs, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref, np.float32),
+                                  np.asarray(out, np.float32))
+    jnp_out = quant.quantized_matmul(x, w, x_scale=xs, impl="jnp")
+    np.testing.assert_array_equal(np.asarray(ref, np.float32),
+                                  np.asarray(jnp_out, np.float32))
+
+
+def test_kernel_accuracy_vs_full_precision():
+    """int8 with per-channel weight scales lands ~1% RMS of the full
+    matmul — the LLM.int8() ballpark; a broken scale convention would
+    be off by orders of magnitude."""
+    x, w, xs = _operands(64, 128, 96, jnp.float32, seed=3)
+    full = np.asarray(x) @ np.asarray(w)
+    q = np.asarray(quant.quantized_matmul(x, w, x_scale=xs, impl="jnp"))
+    rel = np.sqrt(((q - full) ** 2).mean()) / np.sqrt((full ** 2).mean())
+    assert rel < 0.03, rel
+
+
+def test_kernel_bwd_is_bf16_straight_through():
+    """The custom VJP: dx/dw computed from the SAVED full-precision
+    operands in their own dtype (bf16 backward), identical between the
+    interpret kernel and the reference path, and equal to the plain
+    matmul's gradients (straight-through)."""
+    x, w, xs = _operands(16, 32, 24, jnp.bfloat16, seed=1)
+
+    def loss(fn):
+        return lambda x, w: jnp.sum(
+            fn(x, w).astype(jnp.float32) ** 2) / 100.0
+
+    def qloss(x, w, **kw):
+        return jnp.sum(quant.quantized_matmul(
+            x, w, x_scale=xs, **kw).astype(jnp.float32) ** 2) / 100.0
+
+    gx_i, gw_i = jax.grad(lambda x, w: qloss(x, w, interpret=True),
+                          argnums=(0, 1))(x, w)
+    gx_j, gw_j = jax.grad(lambda x, w: qloss(x, w, impl="jnp"),
+                          argnums=(0, 1))(x, w)
+    assert gx_i.dtype == jnp.bfloat16 and gw_i.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(gx_i, np.float32),
+                                  np.asarray(gx_j, np.float32))
+    np.testing.assert_array_equal(np.asarray(gw_i, np.float32),
+                                  np.asarray(gw_j, np.float32))
+    # straight-through: cotangents flow as if the matmul were exact,
+    # seeded by the QUANTIZED forward's output (g = 2*out/100)
+    out = quant.quantized_matmul(x, w, x_scale=xs, impl="jnp")
+    g = (2.0 * out.astype(jnp.float32) / 100.0).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(gx_j, np.float32),
+        np.asarray(jnp.dot(g, w.T).astype(jnp.bfloat16), np.float32))
+
+
+def test_impl_jnp_wins_over_interpret_and_bogus_impl_rejected(monkeypatch):
+    """impl="jnp" is the explicit "reference on this exact call" A/B
+    probe — interpret=True must not override it (review), and a bogus
+    impl must raise even when interpret is set."""
+    from apex_tpu.quant import kernels as K
+
+    x, w, xs = _operands(8, 32, 16, jnp.float32)
+
+    def _boom(*a, **k):
+        raise AssertionError("pallas path dispatched under impl='jnp'")
+
+    monkeypatch.setattr(K, "_pallas_qmm", _boom)
+    out = quant.quantized_matmul(x, w, x_scale=xs, impl="jnp",
+                                 interpret=True)
+    ref = quant.quantized_matmul_ref(x, w, x_scale=xs)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    with pytest.raises(ValueError, match="impl"):
+        quant.quantized_matmul(x, w, x_scale=xs, impl="bogus",
+                               interpret=True)
+
+
+def test_zero_amax_channel_corner():
+    """An all-zero weight column must quantize to exact zeros (scale
+    guard 1.0), produce exact-zero outputs, and not poison neighbors."""
+    x, w, xs = _operands(16, 32, 24, jnp.float32, zero_channel=5)
+    for kw in ({"impl": "jnp"}, {"interpret": True}):
+        out = np.asarray(quant.quantized_matmul(x, w, x_scale=xs, **kw))
+        assert np.all(out[:, 5] == 0.0)
+        assert np.all(np.isfinite(out))
+    # and a zero-amax ACTIVATION tensor round-trips as zeros
+    z = jnp.zeros((4, 32), jnp.float32)
+    out = quant.quantized_matmul(z, w, x_scale=quant.amax_to_scale(0.0),
+                                 impl="jnp")
+    assert np.all(np.asarray(out) == 0.0)
+
+
+def test_quantize_dequantize_roundtrip_and_saturation():
+    x = jnp.asarray([[0.5, -1.0, 2.0, 0.0]], jnp.float32)
+    scale = quant.amax_to_scale(jnp.max(jnp.abs(x)))
+    q = quant.quantize(x, scale)
+    assert q.dtype == jnp.int8
+    back = quant.dequantize(q, scale)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=float(scale) / 2 + 1e-7)
+    # saturation_count: elements past the calibrated range
+    assert int(quant.saturation_count(x, scale)) == 0
+    # |x*2| = [1, 2, 4, 0] against limit 2: only the 4 clips (strict >;
+    # exactly-at-limit quantizes to ±127 without clipping)
+    assert int(quant.saturation_count(x * 2.0, scale)) == 1
+
+
+# -- model hook ---------------------------------------------------------------
+
+def _tiny_lm(quant_cfg=None):
+    return gpt_tiny(dtype=jnp.bfloat16, attention_impl="blockwise",
+                    quant=quant_cfg)
+
+
+def _lm_batch(seed=0, batch=2, seq=16):
+    rs = np.random.RandomState(seed)
+    return jnp.asarray(rs.randint(1, 1024, (batch, seq)))
+
+
+def test_o4_without_calibration_is_bitwise_o2():
+    """The acceptance fallback: a quant-hooked model with NO frozen
+    scales computes bit-for-bit what the plain model computes — O4
+    degrades to O2, never to silently different numerics."""
+    ids = _lm_batch()
+    plain = _tiny_lm()
+    params = plain.init(jax.random.PRNGKey(0), ids)["params"]
+    hooked = _tiny_lm(quant.QuantConfig(mode="quant", scales={}))
+    np.testing.assert_array_equal(
+        np.asarray(plain.apply({"params": params}, ids)),
+        np.asarray(hooked.apply({"params": params}, ids)))
+    # param trees are interchangeable (same names, shapes, init draws)
+    p2 = hooked.init(jax.random.PRNGKey(0), ids)["params"]
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(p2))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params, p2)
+
+
+def _calibrate_tiny(params, ids, n=2):
+    obs = _tiny_lm(quant.QuantConfig.observe())
+    cal = quant.Calibrator()
+    for i in range(n):
+        _, st = obs.apply({"params": params}, _lm_batch(seed=i),
+                          mutable=["quant_stats"])
+        cal.harvest(jax.device_get(st["quant_stats"]))
+    return cal
+
+
+def test_observe_phase_collects_every_projection_site():
+    ids = _lm_batch()
+    params = _tiny_lm().init(jax.random.PRNGKey(0), ids)["params"]
+    cal = _calibrate_tiny(params, ids)
+    # gpt_tiny: 2 blocks x (q, k, v, out, mlp_up, mlp_down) = 12 sites
+    assert len(cal.sites) == 12, cal.sites
+    assert "block_0/mlp_up" in cal.sites
+    assert "block_1/attention/query" in cal.sites
+    calib = cal.freeze()
+    assert all(s > 0 for s in calib.scales.values())
+    # percentile mode clips the history's outlier tail
+    p = cal.freeze(mode=50.0)
+    assert all(p.amax[k] <= calib.amax[k] for k in calib.amax)
+
+
+def test_frozen_calibration_quantizes_and_stays_finite():
+    ids = _lm_batch()
+    params = _tiny_lm().init(jax.random.PRNGKey(0), ids)["params"]
+    calib = _calibrate_tiny(params, ids).freeze()
+    qm = _tiny_lm(quant.QuantConfig.frozen(calib))
+    l_q = np.asarray(qm.apply({"params": params}, ids), np.float32)
+    l_p = np.asarray(_tiny_lm().apply({"params": params}, ids),
+                     np.float32)
+    assert np.all(np.isfinite(l_q))
+    assert not np.array_equal(l_q, l_p)      # the int8 path really ran
+    # interpret mode (the REAL kernel) agrees with the jnp quant path
+    qi = _tiny_lm(quant.QuantConfig.frozen(calib, interpret=True))
+    np.testing.assert_array_equal(
+        l_q, np.asarray(qi.apply({"params": params}, ids), np.float32))
+
+
+# -- calibration round-trip through checkpoint extras -------------------------
+
+def test_calibration_checkpoint_extra_roundtrip(tmp_path):
+    from apex_tpu.checkpoint import (CheckpointManager,
+                                     latest_checkpoint,
+                                     load_checkpoint_dir)
+
+    ids = _lm_batch()
+    params = _tiny_lm().init(jax.random.PRNGKey(0), ids)["params"]
+    calib = _calibrate_tiny(params, ids).freeze()
+    state = {"w": jnp.ones((3,), jnp.float32)}
+    with CheckpointManager(str(tmp_path), async_write=False) as mgr:
+        mgr.save(7, state, quant_calibration=calib.state_dict())
+    restored = load_checkpoint_dir(latest_checkpoint(str(tmp_path)),
+                                   like=state)
+    back = quant.Calibration.from_state_dict(
+        restored.extra["quant_calibration"])
+    assert back.scales == calib.scales
+    assert back.amax == calib.amax
+    assert back.meta["mode"] == "max"
+    # and the restored scales drive the model identically
+    a = _tiny_lm(quant.QuantConfig.frozen(calib)).apply(
+        {"params": params}, ids)
+    b = _tiny_lm(quant.QuantConfig.frozen(back)).apply(
+        {"params": params}, ids)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_calibration_rejects_unknown_version_and_empty_freeze():
+    with pytest.raises(ValueError, match="version"):
+        quant.Calibration.from_state_dict({"version": 99})
+    with pytest.raises(ValueError, match="observation"):
+        quant.Calibrator().freeze()
+    with pytest.raises(ValueError, match="percentile"):
+        c = quant.Calibrator()
+        c.observe("a", 1.0)
+        c.freeze(mode=0.0)
+
+
+# -- O4 training: convergence + trace pins ------------------------------------
+
+def _o4_setup(calibrated=True):
+    from convergence_quant import (build_model, calibrate,
+                                   make_lm_dataset)
+
+    model_kw = dict(vocab=64, hidden=64, layers=2, heads=4, seq=32)
+    batches = make_lm_dataset(16, 4, 32, 64)
+    plain = build_model(None, **model_kw)
+    params = plain.init(jax.random.PRNGKey(0),
+                        jnp.asarray(batches[0][:, :-1]))["params"]
+    calib = calibrate(params, batches, **model_kw) if calibrated else None
+    model = build_model(
+        quant.QuantConfig.frozen(calib) if calibrated else None,
+        **model_kw)
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b[:, :-1])
+        logp = jax.nn.log_softmax(
+            logits.reshape(-1, 64).astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(
+            logp, b[:, 1:].reshape(-1)[:, None], axis=1))
+
+    return params, batches, loss_fn
+
+
+def test_o4_tracks_o2_on_the_small_lm():
+    """The CI-scale CONVERGENCE_QUANT gate: 120 steps of the noisy-
+    bigram LM, O4's curve tracks O2's (the on-chip artifact runs the
+    same harness at full depth — tools/convergence_quant.py)."""
+    from convergence import gate
+    from convergence_quant import run_lm_curve
+
+    kw = dict(batch=8, seq=32, vocab=64, hidden=64, layers=2, lr=3e-3)
+    losses_o2, _ = run_lm_curve("O2", 120, **kw)
+    losses_o4, _ = run_lm_curve("O4", 120, **kw)
+    v = gate(losses_o2, losses_o4, tail=30, track_tol=0.15)
+    assert v["ok"], v
+
+
+def test_o4_step_zero_retraces_under_warmup():
+    """The quantized step through StepPipeline: frozen scales are trace
+    constants, so AOT warmup pins ONE program and the whole run pays
+    zero further traces (acceptance: zero steady-state retraces)."""
+    params, batches, loss_fn = _o4_setup()
+    tx = training.adam(lr=1e-3)
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level="O4",
+                                       loss_scale="dynamic")
+    state = init_fn(params)
+    K = 2
+    pipe = runtime.StepPipeline(step_fn, K)
+
+    def window(i=0):
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *batches[i:i + K])
+
+    pipe.warmup(state, window())
+    with assert_trace_count(pipe.loop, 0):
+        for i in range(3):
+            state, metrics = pipe.step_window(state, window(), K)
+    losses = np.ravel(jax.device_get(metrics["loss"]))
+    assert np.all(np.isfinite(losses))
+
+
+def test_o4_state_layout_matches_o2():
+    """O4 is O2's storage semantics exactly: fp32 stored params (the
+    masters), identical optimizer-state tree, loss scaling wired."""
+    params, batches, loss_fn = _o4_setup()
+    tx = training.adam(lr=1e-3)
+    init_o4, _ = make_train_step(loss_fn, tx, opt_level="O4",
+                                 loss_scale="dynamic")
+    init_o2, _ = make_train_step(loss_fn, tx, opt_level="O2",
+                                 loss_scale="dynamic")
+    s4, s2 = init_o4(params), init_o2(params)
+    for leaf in jax.tree_util.tree_leaves(s4.params):
+        assert leaf.dtype == jnp.float32
+    assert (jax.tree_util.tree_structure(s4)
+            == jax.tree_util.tree_structure(s2))
+    assert float(s4.scaler.loss_scale) == float(s2.scaler.loss_scale)
+
+
+# -- int8 KV cache ------------------------------------------------------------
+
+def test_int8_pool_scatter_gather_roundtrip_tolerance():
+    """Pool round-trip error bounded by the per-row quantization grid
+    (scale/2 per element), per (token, head) scales."""
+    model = gpt_tiny(max_len=64, dtype=jnp.float32)
+    pool_k, pool_v = KV.make_pool(model, n_pages=5, page_size=4,
+                                  dtype=jnp.int8)
+    assert isinstance(pool_k, KV.QuantPool)
+    assert pool_k.dtype == jnp.float32          # the dense-view dtype
+    rs = np.random.RandomState(0)
+    L, _, page, n_kv, hd = pool_k.shape
+    bucket = 2 * page
+    dense = jnp.asarray(rs.randn(L, bucket, n_kv, hd), jnp.float32)
+    pages = jnp.asarray([1, 3], jnp.int32)
+    pool_k = KV.scatter_prefill(pool_k, pages, dense)
+    tables = np.asarray([[1, 3]], np.int32)
+    views = KV.gather_views(pool_k, pool_v, tables)
+    got = np.stack([k[0] for k, _ in views])    # [L, bucket, n_kv, hd]
+    amax = np.abs(np.asarray(dense)).max(axis=-1, keepdims=True)
+    np.testing.assert_allclose(got, np.asarray(dense),
+                               atol=float(amax.max()) / 254 + 1e-6)
+    # single-token scatter writes one row at the right offset
+    tok = jnp.asarray(rs.randn(L, 1, n_kv, hd), jnp.float32)
+    pool_k = KV.scatter_token(pool_k, jnp.asarray([3], jnp.int32),
+                              jnp.asarray([2], jnp.int32), tok)
+    views = KV.gather_views(pool_k, pool_v, tables)
+    row = np.stack([k[0] for k, _ in views])[:, page + 2]
+    np.testing.assert_allclose(
+        row, np.asarray(tok)[:, 0],
+        atol=float(np.abs(np.asarray(tok)).max()) / 254 + 1e-6)
+
+
+def test_int8_kv_decode_parity_and_capacity():
+    """End-to-end serving parity: the int8-KV engine decodes the same
+    greedy tokens as the full-precision engine on the tiny LM (all
+    deterministic — seeds fixed), pays zero AOT misses, and the
+    equal-HBM page capacity is >= 1.5x bf16's."""
+    model = gpt_tiny(max_len=128, dtype=jnp.float32)
+    rs = np.random.RandomState(0)
+    probe = jnp.asarray(rs.randint(1, 1024, (1, 8)))
+    params = model.init(jax.random.PRNGKey(1), probe)["params"]
+    prompts = [rs.randint(1, 1024, (n,)).astype(np.int32)
+               for n in (5, 17, 30)]
+
+    def run(dtype):
+        eng = serving.ServingEngine(model, params, buckets=(32, 64),
+                                    page_size=8, max_seqs=4,
+                                    cache_dtype=dtype)
+        eng.warmup()
+        res = eng.generate(prompts, max_new_tokens=8)
+        toks = [r.tokens for r in res]
+        stats = dict(eng.stats)
+        dt = eng.kv_cache_dtype
+        eng.close()
+        return toks, stats, dt
+
+    t_ref, s_ref, dt_ref = run(None)
+    t_q, s_q, dt_q = run(jnp.int8)
+    assert dt_q == "int8" and dt_ref == "float32"
+    for a, b in zip(t_ref, t_q):
+        np.testing.assert_array_equal(a, b)
+    assert s_q["aot_misses"] == 0
+    assert s_q["kv_bytes_per_token"] < s_ref["kv_bytes_per_token"] / 2
+    # equal-HBM capacity: int8 admits >= 1.5x the bf16 pages
+    budget = 8 * 1024 * 1024
+    bf16 = KV.pages_for_budget(model, 8, budget, jnp.bfloat16)
+    i8 = KV.pages_for_budget(model, 8, budget, jnp.int8)
+    assert i8 >= 1.5 * bf16, (i8, bf16)
+
+
+def test_int8_kv_bitwise_stable_across_hotswap(tmp_path):
+    """The acceptance gate: int8-KV serving through a mid-load weight
+    hot-swap — post-swap output bitwise equals a fresh int8 engine on
+    the new weights, and the run is deterministic end to end."""
+    from apex_tpu.checkpoint import CheckpointManager
+
+    model = gpt_tiny(max_len=64, dtype=jnp.float32)
+    rs = np.random.RandomState(2)
+    probe = jnp.asarray(rs.randint(1, 1024, (1, 8)))
+    params = model.init(jax.random.PRNGKey(1), probe)["params"]
+    params_v2 = jax.tree_util.tree_map(lambda x: x * 1.01, params)
+    prompts = [rs.randint(1, 1024, (n,)).astype(np.int32)
+               for n in (5, 12, 20)]
+    eng = serving.ServingEngine(model, params, buckets=(32,),
+                                page_size=8, max_seqs=2,
+                                cache_dtype=jnp.int8,
+                                watch_dir=str(tmp_path),
+                                poll_every_s=3600)
+    try:
+        eng.warmup()
+        comps = [eng.submit(p, 6) for p in prompts[:2]]
+        for _ in range(3):
+            eng.step()
+        with CheckpointManager(str(tmp_path), procs=(0, 1),
+                               async_write=False) as mgr:
+            mgr.save(11, params_v2)
+        assert eng.watcher.poll_once()
+        comps += [eng.submit(prompts[2], 6)]
+        eng.run_until_idle()
+        assert all(c.result(timeout=0).ok for c in comps)
+        assert eng.stats["hotswaps"] == 1
+        post = eng.generate([prompts[0]], max_new_tokens=6)[0]
+    finally:
+        eng.close()
+    ref = serving.ServingEngine(model, params_v2, buckets=(32,),
+                                page_size=8, max_seqs=2,
+                                cache_dtype=jnp.int8)
+    try:
+        ref.warmup()
+        expect = ref.generate([prompts[0]], max_new_tokens=6)[0]
+    finally:
+        ref.close()
+    np.testing.assert_array_equal(post.tokens, expect.tokens)
+
+
+def test_serving_kv_stats_and_run_info_label(tmp_path):
+    """kv_bytes_per_token rides the stats + a gauge, and the engine
+    stamps kv_cache_dtype into the Prometheus run_info labels."""
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import export as T_export
+
+    model = gpt_tiny(max_len=64, dtype=jnp.float32)
+    probe = jnp.asarray(np.random.RandomState(0).randint(1, 1024, (1, 4)))
+    params = model.init(jax.random.PRNGKey(1), probe)["params"]
+    path = str(tmp_path / "run.jsonl")
+    rec = telemetry.start(path)
+    try:
+        eng = serving.ServingEngine(model, params, buckets=(32,),
+                                    page_size=8, max_seqs=2,
+                                    cache_dtype=jnp.int8)
+        eng.warmup()
+        eng.generate([np.asarray([5, 6, 7], np.int32)],
+                     max_new_tokens=2)
+        expo = T_export.render(rec)
+        eng.close()
+    finally:
+        rec.close()
+    assert 'kv_cache_dtype="int8"' in expo
+    assert "serving_kv_bytes_per_token" in expo
+    expected = KV.kv_bytes_per_token(model, jnp.int8)
+    assert f"serving_kv_bytes_per_token {expected}" in expo
+
+
+# -- saturation telemetry + watchdog ------------------------------------------
+
+def test_saturation_note_feeds_quant_watchdog_rule(tmp_path):
+    """Calibration.note_saturation -> quant event -> the
+    quant_scale_saturation rule fires (and stays silent under the
+    threshold)."""
+    import json
+
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import watchdog as W
+
+    calib = quant.Calibration({"block_0/mlp_up": 0.01},
+                              {"block_0/mlp_up": 1.27})
+    path = str(tmp_path / "run.jsonl")
+    rec = telemetry.start(path, watchdog=True)
+    try:
+        calib.note_saturation("block_0/mlp_up", 2, window=32)   # benign
+        calib.note_saturation("block_0/mlp_up", 9, window=32)   # burst
+    finally:
+        rec.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    alerts = [e for e in events if e.get("kind") == "alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["rule"] == "quant_scale_saturation"
+    assert alerts[0]["severity"] == "warning"
+    assert alerts[0]["value"] == 9
+    quants = [e for e in events if e.get("kind") == "quant"]
+    assert len(quants) == 2 and quants[0]["exceeded"] == 2
+    assert calib.saturations == {"block_0/mlp_up": 11}
+    # the rule is part of the default set
+    assert "quant_scale_saturation" in W.RULE_NAMES
+
+
+def test_saturation_count_drives_note(tmp_path):
+    """The device-side count + the host note compose: quantize a tensor
+    that outgrew its calibration and the counter reaches telemetry."""
+    import json
+
+    from apex_tpu import telemetry
+
+    x = jnp.asarray(np.linspace(-2.0, 2.0, 64), jnp.float32)
+    scale = quant.amax_to_scale(1.0)            # calibrated for |x|<=1
+    n = int(quant.saturation_count(x, scale))
+    assert n > 0
+    calib = quant.Calibration({"s": float(scale)}, {"s": 1.0})
+    path = str(tmp_path / "run.jsonl")
+    rec = telemetry.start(path)
+    try:
+        calib.note_saturation("s", n, window=1)
+    finally:
+        rec.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    ev = [e for e in events if e.get("kind") == "quant"]
+    assert ev and ev[0]["exceeded"] == n
+
+
+# -- amp plumbing -------------------------------------------------------------
+
+def test_amp_o4_preset_and_frontend():
+    from apex_tpu.amp.properties import AmpOptionError, opt_levels
+
+    p = opt_levels["O4"]()
+    assert p.master_weights and p.keep_batchnorm_fp32 and p.quantize
+    assert jnp.dtype(p.cast_model_type) == jnp.dtype(jnp.bfloat16)
+    assert not opt_levels["O2"]().quantize
+    with pytest.raises(AmpOptionError, match="quantize"):
+        p2 = opt_levels["O1"]()
+        p2.quantize = True
+    from apex_tpu import amp
+    with pytest.raises(AmpOptionError, match="O4"):
+        amp.initialize(models={"w": jnp.ones((2,))}, opt_level="O9")
+    # the exclusivity holds through the OVERRIDE path too, not only on
+    # quantize assignment (review: the preset sets quantize first, so
+    # the patch_functions setter must also reject O4)
+    with pytest.raises(AmpOptionError, match="O2/O3/O4"):
+        amp.initialize(models={"w": jnp.ones((2,))}, opt_level="O4",
+                       patch_functions=True)
+    # and directly on the Properties surface, even with quantize unset
+    p3 = opt_levels["O4"]()
+    p3.quantize = False
+    with pytest.raises(AmpOptionError, match="O2/O3/O4"):
+        p3.patch_functions = True
+
+
+def test_mesh_zero3_accepts_o4():
+    from apex_tpu.parallel import mesh as M
+
+    plan = M.MeshPlan(dp=1, fsdp=8, devices=jax.devices("cpu")[:8])
+
+    def loss(p, b):
+        return jnp.mean((b[0] @ p["w"] - b[1]) ** 2)
+
+    ms = M.make_mesh_train_step(loss, training.adam(1e-2), plan,
+                                zero=3, opt_level="O4")
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(5, 7) * 0.3, jnp.float32)}
+    state = ms.init(params)
+    step = ms.jit_step(state, donate=False)
+    x = jnp.asarray(rs.randn(8, 5), jnp.float32)
+    y = jnp.asarray(rs.randn(8, 7) * 0.1, jnp.float32)
+    state, m = step(state, plan.device_put_batch((x, y)))
+    assert np.isfinite(float(jnp.ravel(m["loss"])[0]))

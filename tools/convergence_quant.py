@@ -1,0 +1,201 @@
+"""O4-vs-O2 convergence artifact on the small LM (ISSUE 13 gate).
+
+The int8 engine's acceptance is a TRAJECTORY property, not a one-matmul
+tolerance: with every GPT projection quantized (per-tensor calibrated
+activations, per-channel weights, bf16 straight-through backward), the
+O4 loss curve must TRACK the O2 curve over hundreds of optimization
+steps on a memorizable LM dataset — the same harness shape as
+``tools/convergence.py`` (O2-vs-O0) and the CONVERGENCE_*.json artifact
+family.
+
+Recipe under test is exactly docs/quant.md's: observe a few batches
+through the ``mode="observe"`` model, freeze the delayed-amax-history
+calibration, rebuild with ``QuantConfig.frozen`` and train at
+``opt_level="O4"`` (storage semantics identical to O2 — the quantized
+sites are the ONLY difference between the two curves).
+
+Run (CPU works; the artifact records the backend)::
+
+    python tools/convergence_quant.py --steps 240 --out CONVERGENCE_QUANT.json
+
+``tests/test_quant.py`` runs the same harness at reduced depth in CI,
+and ``tests/test_convergence.py`` re-validates any committed artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os as _os
+import sys as _sys
+import time
+
+import numpy as np
+
+try:
+    import apex_tpu  # noqa: F401
+except ModuleNotFoundError:  # running from a source checkout
+    _sys.path.insert(0, _os.path.abspath(_os.path.join(
+        _os.path.dirname(__file__), _os.pardir)))
+
+from convergence import gate  # noqa: E402  (same gate definition: learn + track)
+
+
+def make_lm_dataset(n_batches, batch, seq, vocab, seed=0, noise=0.1):
+    """Fixed noisy-bigram next-token batches.
+
+    A memorize-to-zero dataset (convergence.py's fixed random batches)
+    is the WRONG gate for quantization: O2 drives the loss toward 0
+    while int8 forward noise sets a small irreducible floor, so the
+    relative tail gap diverges on a vanishing denominator.  A noisy
+    bigram process has a nonzero entropy floor BOTH levels converge to
+    (next token = a fixed random successor with prob ``1 - noise``,
+    uniform otherwise; enough distinct batches that memorizing the
+    noise is out of capacity) — the honest scale for "O4 tracks O2"."""
+    rng = np.random.RandomState(seed)
+    succ = rng.randint(0, vocab, vocab)           # the bigram table
+    out = []
+    for _ in range(n_batches):
+        b = np.empty((batch, seq + 1), np.int64)
+        b[:, 0] = rng.randint(0, vocab, batch)
+        for t in range(seq):
+            flip = rng.rand(batch) < noise
+            b[:, t + 1] = np.where(flip, rng.randint(0, vocab, batch),
+                                   succ[b[:, t]])
+        out.append(b.astype(np.int32))
+    return out
+
+
+def build_model(quant_cfg=None, *, vocab=256, hidden=64, layers=2,
+                heads=4, seq=32):
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPT
+
+    return GPT(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+               num_heads=heads, mlp_dim=hidden * 4, max_len=seq,
+               dtype=jnp.bfloat16, attention_impl="blockwise",
+               quant=quant_cfg)
+
+
+def calibrate(params, batches, *, n_observe=4, history=16, mode="max",
+              **model_kw):
+    """The observation phase: run ``n_observe`` batches through the
+    observe-mode model, harvest the quant_stats collection per batch,
+    freeze the delayed-amax-history calibration."""
+    import jax
+
+    from apex_tpu import quant
+
+    obs = build_model(quant.QuantConfig.observe(), **model_kw)
+    cal = quant.Calibrator(history=history)
+    for b in batches[:n_observe]:
+        _, st = obs.apply({"params": params}, b[:, :-1],
+                          mutable=["quant_stats"])
+        cal.harvest(jax.device_get(st["quant_stats"]))  # jaxlint: disable=J001 -- the calibration observation boundary: absmax stats must reach the host to freeze scales; a handful of batches, not the training loop
+    return cal.freeze(mode)
+
+
+def run_lm_curve(opt_level, steps, *, batch=8, seq=32, vocab=64,
+                 hidden=64, layers=2, heads=4, lr=3e-3, n_batches=64,
+                 seed=0, log_every=0, interpret=False,
+                 calibration=None):
+    """One LM loss curve at ``opt_level``.  For O4 a calibration is
+    harvested from the initial params (or passed in); every other knob
+    is shared with the O2 run, so the curves differ ONLY by the
+    quantized sites."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import quant, training
+    from apex_tpu.training import make_train_step
+
+    model_kw = dict(vocab=vocab, hidden=hidden, layers=layers,
+                    heads=heads, seq=seq)
+    batches = make_lm_dataset(n_batches, batch, seq, vocab, seed=seed)
+    plain = build_model(None, **model_kw)
+    params = plain.init(jax.random.PRNGKey(seed),
+                        jnp.asarray(batches[0][:, :-1]))["params"]
+
+    if opt_level == "O4":
+        if calibration is None:
+            calibration = calibrate(params, batches, **model_kw)
+        model = build_model(
+            quant.QuantConfig.frozen(calibration, interpret=interpret),
+            **model_kw)
+    else:
+        model = plain
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b[:, :-1])
+        logp = jax.nn.log_softmax(
+            logits.reshape(-1, vocab).astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(
+            logp, b[:, 1:].reshape(-1)[:, None], axis=1))
+
+    tx = training.adam(lr=lr)
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level=opt_level,
+                                       loss_scale="dynamic")
+    state = init_fn(params)
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    dev = [jnp.asarray(b) for b in batches]
+    refs = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = step(state, dev[i % len(dev)])
+        refs.append(jnp.ravel(m["loss"])[0])
+        if log_every and i % log_every == 0:
+            print(f"  [{opt_level}] step {i} "
+                  f"loss {float(refs[-1]):.4f}", flush=True)
+    losses = [float(v) for v in np.asarray(jnp.stack(refs))]
+    return losses, time.perf_counter() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=240)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--track-tol", type=float, default=0.15)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    cfg = dict(steps=args.steps, batch=args.batch, seq=args.seq,
+               vocab=args.vocab, hidden=args.hidden, layers=args.layers,
+               lr=args.lr, backend=jax.default_backend(),
+               device_kind=jax.devices()[0].device_kind)
+    kw = dict(batch=args.batch, seq=args.seq, vocab=args.vocab,
+              hidden=args.hidden, layers=args.layers, lr=args.lr,
+              log_every=50)
+    losses_o2, dt2 = run_lm_curve("O2", args.steps, **kw)
+    losses_o4, dt4 = run_lm_curve("O4", args.steps, **kw)
+    verdict = gate(losses_o2, losses_o4, track_tol=args.track_tol)
+    # gate() names its operands o0/o2; restate them as o2/o4 so a
+    # reader never mistakes which levels were compared
+    ren = {"head_mean_o0": "head_mean_o2", "head_mean_o2": "head_mean_o4",
+           "tail_mean_o0": "tail_mean_o2", "tail_mean_o2": "tail_mean_o4",
+           "o0_learned": "o2_learned", "o2_learned": "o4_learned",
+           "o2_tracks_o0": "o4_tracks_o2"}
+    verdict = {ren.get(k, k): v for k, v in verdict.items()}
+    artifact = {"kind": "quant", "config": cfg,
+                "verdict": {**verdict, "compared": "O4 vs O2"},
+                "wall_s_o2": round(dt2, 1), "wall_s_o4": round(dt4, 1),
+                "losses_o2": [round(l, 5) for l in losses_o2],
+                "losses_o4": [round(l, 5) for l in losses_o4]}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f)
+    print(json.dumps({"convergence_quant_ok": verdict["ok"],
+                      **verdict, "steps": args.steps,
+                      "backend": cfg["backend"]}))
+    if not verdict["ok"]:
+        raise SystemExit("CONVERGENCE_QUANT GATE FAILED")
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,8 @@ PAGES = {
     "checkpoint_data": ["apex_tpu.checkpoint", "apex_tpu.data"],
     "serving": ["apex_tpu.serving", "apex_tpu.serving.engine",
                 "apex_tpu.serving.kv_cache", "apex_tpu.serving.hotswap"],
+    "quant": ["apex_tpu.quant", "apex_tpu.quant.kernels",
+              "apex_tpu.quant.calibrate", "apex_tpu.quant.layers"],
 }
 
 

@@ -98,6 +98,15 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   on one device: the partitioner reshuffles it per sharded call and
   AOT warmup cannot pin the placement — derive it from the plan
   (``plan.named(...)``/``plan.batch_sharding()``) instead (ISSUE 12).
+* **J014** (advisory) per-step recalibration at quantized-matmul call
+  sites: a ``quantized_matmul``/``quant_matmul`` call whose ``x_scale``
+  /``scale`` argument is a freshly computed ``abs().max()`` (inline or
+  via a same-function local).  The activation scale is supposed to be a
+  FROZEN calibration constant (``apex_tpu.quant.Calibrator`` observe →
+  freeze); re-deriving it in the step pays a full extra reduction per
+  dispatch and silently changes the numerics the CONVERGENCE_QUANT
+  gate certified.  ``w_scale`` is exempt — weights are exact at trace
+  time, per-step channel scales are the correct recipe (ISSUE 13).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -151,11 +160,16 @@ RULES: Dict[str, str] = {
             "point (the array lands replicated/on one device and the "
             "partitioner reshuffles it per call; derive the placement "
             "from the MeshPlan; advisory)",
+    "J014": "quantized-matmul call site whose scale argument is a "
+            "freshly computed abs().max() (recalibration-per-step: the "
+            "per-tensor activation scale should come from a FROZEN "
+            "apex_tpu.quant calibration, not be re-derived inside the "
+            "step; advisory)",
 }
 
 #: Rules reported as advice, not errors: the CLI exits 0 when only
 #: advisory findings remain, and ``Finding.advisory`` marks them.
-ADVISORY_RULES: Set[str] = {"J011", "J013"}
+ADVISORY_RULES: Set[str] = {"J011", "J013", "J014"}
 
 # Functions whose *contract* is the host boundary: serialization must
 # materialize host values, so J001 does not fire inside them.  Everything
@@ -914,6 +928,122 @@ def _check_j013(tree: ast.Module, path: str) -> List[Finding]:
     return findings
 
 
+# -- J014: per-step recalibration at quantized-matmul call sites --------------
+
+#: call names that take a calibrated scale (the apex_tpu.quant surface
+#: plus the obvious user spellings)
+_J014_QUANT_CALLS = {"quantized_matmul", "quant_matmul",
+                     "quantized_matmul_ref"}
+
+#: keyword arguments that carry an ACTIVATION scale.  ``w_scale`` is
+#: deliberately absent: weights are exact at trace time, so deriving
+#: their per-channel scale in-step is the correct recipe.
+_J014_SCALE_KWARGS = {"x_scale", "scale"}
+
+_J014_ABS_NAMES = {"abs", "absolute"}
+_J014_MAX_NAMES = {"max", "amax", "nanmax"}
+
+
+def _j014_call_leaf(call: ast.Call) -> Optional[str]:
+    """The trailing name of a call: ``jnp.abs`` -> ``abs``, and the
+    method form ``expr.max()`` -> ``max`` (an Attribute on a non-name
+    value has no dotted spelling but its attr still identifies it)."""
+    name = _dotted(call.func)
+    if name:
+        return name.split(".")[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _j014_contains_abs(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and _j014_call_leaf(sub) in _J014_ABS_NAMES:
+            return True
+    return False
+
+
+def _j014_is_fresh_absmax(node: ast.AST) -> bool:
+    """True when ``node`` computes an absmax inline: ``jnp.max(jnp.abs(
+    x))`` / ``jnp.abs(x).max()`` / ``abs(x).max()`` — the per-step
+    recalibration shape.  A frozen float, an attribute read
+    (``calib.scales[...]``) or a plain name resolves False here; names
+    assigned from an absmax in the SAME function are resolved by the
+    caller."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        if _j014_call_leaf(sub) in _J014_MAX_NAMES \
+                and _j014_contains_abs(sub):
+            return True
+    return False
+
+
+def _j014_scope_walk(fn):
+    """``ast.walk`` limited to ``fn``'s OWN scope: nested function defs
+    are their own J014 scopes, so a helper's local ``s = abs(x).max()``
+    must not mark the enclosing function's ``s`` (a frozen calibration
+    constant) as fresh.  Lambdas cannot contain assignments, so their
+    bodies stay included (call-site coverage, no name pollution)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_j014(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # one-level local resolution (the J009 pattern): a name assigned
+        # from a fresh absmax in this function is as fresh as the
+        # expression itself.  Binding-order aware: what matters is the
+        # LAST assignment to the name before the call site, so
+        # ``s = abs(x).max()/127; s = calib.scales[k]`` resolves frozen
+        bindings: Dict[str, List[Tuple[int, bool]]] = {}
+        for node in _j014_scope_walk(fn):
+            if isinstance(node, ast.Assign):
+                fresh_val = _j014_is_fresh_absmax(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        bindings.setdefault(tgt.id, []).append(
+                            (node.lineno, fresh_val))
+
+        def _name_fresh_at(name: str, lineno: int) -> bool:
+            prior = [b for b in bindings.get(name, ())
+                     if b[0] <= lineno]
+            return bool(prior) and max(prior)[1]
+
+        for node in _j014_scope_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if not name or name.split(".")[-1] not in _J014_QUANT_CALLS:
+                continue
+            for kw in node.keywords:
+                if kw.arg not in _J014_SCALE_KWARGS:
+                    continue
+                fresh = _j014_is_fresh_absmax(kw.value) or (
+                    isinstance(kw.value, ast.Name)
+                    and _name_fresh_at(kw.value.id, node.lineno))
+                if fresh:
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset, "J014",
+                        f"{kw.arg}= is a freshly computed abs().max() — "
+                        f"per-step recalibration re-derives the int8 "
+                        f"range every dispatch (an extra full reduction "
+                        f"over the activations) and unpins the "
+                        f"numerics the convergence gate certified; "
+                        f"freeze scales once via apex_tpu.quant."
+                        f"Calibrator and pass the calibrated constant"))
+    return findings
+
+
 # -- per-scope walker: J001, J004, J005, J006 ---------------------------------
 
 class _ScopeWalker:
@@ -1501,6 +1631,7 @@ def lint_source(src: str, path: str = "<string>",
     findings += _check_j003(tree, path)
     findings += _check_j011(tree, path)
     findings += _check_j013(tree, path)
+    findings += _check_j014(tree, path)
     _ScopeWalker(idx, path, driver, findings).lint_module(tree)
     kept = [f for f in findings if not waivers.waived(f)]
     kept += waivers.errors
