@@ -20,6 +20,9 @@ snapshot surveyed in SURVEY.md), designed TPU-first:
 * warm start (``apex_tpu.cache``) — persistent XLA compilation cache +
   AOT warmup of the step-pipeline device loop (zero compiles after
   step 0).
+* kernel autotuning (``apex_tpu.tune``) — roofline-driven block/layout
+  search for every Pallas kernel with a persistent per-device config
+  cache consulted at dispatch time (``python -m apex_tpu.tune``).
 * legacy surfaces: ``bf16_utils`` (= reference fp16_utils), ``RNN``,
   ``reparameterization``, ``contrib``.
 """
@@ -35,7 +38,7 @@ import importlib as _importlib
 
 _LAZY = ("optimizers", "normalization", "parallel", "bf16_utils", "fp16_utils",
          "RNN", "reparameterization", "contrib", "prof", "training", "models",
-         "runtime", "data", "telemetry", "cache")
+         "runtime", "data", "telemetry", "cache", "tune")
 
 
 def __getattr__(name):

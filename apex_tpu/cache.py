@@ -93,6 +93,15 @@ def enable(path: str, *,
             jax.config.update(name, val)
         except (AttributeError, ValueError):     # pragma: no cover
             pass
+    # The kernel autotuner's per-device config cache (ISSUE 14) lives
+    # beside the compiled-executable store: one cache directory holds
+    # both halves of warm start — programs AND the block configs the
+    # programs were built with.
+    try:
+        from .tune import store as _tune_store
+        _tune_store.set_default_dir(path)
+    except Exception:                            # pragma: no cover
+        pass
     _STATE["dir"] = path
     return path
 

@@ -33,8 +33,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ...normalization.fused_layer_norm import _use_pallas
+from ...tune.dispatch import kernel_config as _tuned_config
+from ...tune.space import pow2_bucket as _pow2
 
 __all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+#: config-cache version of this kernel's blocking scheme (ISSUE 14).
+TUNE_VERSION = 1
 
 
 # -- reference math (jnp fallback + oracle) -----------------------------------
@@ -65,15 +70,31 @@ _ROW_BLOCK = 128
 _VMEM_BUFFER_BUDGET = 2 * 1024 * 1024   # bytes per fp32 [R, H] working buffer
 
 
-def _row_block(n, h):
+def _row_block(n, h, row_block=None):
     """Rows per grid step, sized so the fp32 [R, H] working buffers stay
     inside the TPU's ~16MB scoped-VMEM limit even for LM-head-sized
     vocabularies (e.g. H=30522).  The backward kernel holds up to ~6 live
     [R, H] intermediates (logits, softmax, onehot/iota, grad-out), hence the
-    conservative per-buffer budget."""
-    rows = min(_ROW_BLOCK, _VMEM_BUFFER_BUDGET // (4 * h))
+    conservative per-buffer budget.  ``row_block`` overrides the 128-row
+    cap (the autotuner's knob, ISSUE 14); the budget clamp below it
+    keeps any tuned value VMEM-legal."""
+    rows = min(row_block or _ROW_BLOCK, _VMEM_BUFFER_BUDGET // (4 * h))
     rows = max(8, (rows // 8) * 8)      # sublane multiple
     return min(rows, max(8, n))
+
+
+def tune_bucket(n, h):
+    """Config-cache shape bucket: vocab width exact (it sets the budget
+    math), rows rounded to a power of two."""
+    return f"r{_pow2(n)}_h{h}"
+
+
+def _tuned_rows(n, h):
+    """Dispatch-time consult (ISSUE 14): the tuned ``row_block`` for
+    this shape bucket, or None (the hard-coded default)."""
+    cfg = _tuned_config("xentropy", TUNE_VERSION, tune_bucket(n, h),
+                        params=("row_block",))
+    return cfg["row_block"] if cfg else None
 
 
 def _pallas_fits(h):
@@ -112,9 +133,10 @@ def _bwd_kernel(g_ref, x_ref, mlse_ref, lab_ref, dx_ref, *, smoothing):
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
 
-def _fwd_pallas(logits, labels, smoothing, interpret=False):
+def _fwd_pallas(logits, labels, smoothing, interpret=False,
+                row_block=None):
     n, h = logits.shape
-    blk = _row_block(n, h)
+    blk = _row_block(n, h, row_block)
     grid = (n + blk - 1) // blk
     loss, mlse = pl.pallas_call(
         functools.partial(_fwd_kernel, smoothing=smoothing),
@@ -130,9 +152,10 @@ def _fwd_pallas(logits, labels, smoothing, interpret=False):
     return loss[:, 0], mlse[:, 0]
 
 
-def _bwd_pallas(g, logits, mlse, labels, smoothing, interpret=False):
+def _bwd_pallas(g, logits, mlse, labels, smoothing, interpret=False,
+                row_block=None):
     n, h = logits.shape
-    blk = _row_block(n, h)
+    blk = _row_block(n, h, row_block)
     grid = (n + blk - 1) // blk
     return pl.pallas_call(
         functools.partial(_bwd_kernel, smoothing=smoothing),
@@ -165,7 +188,9 @@ def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
 def _fwd_impl(logits, labels, smoothing):
     labels = labels.astype(jnp.int32)
     if _use_pallas() and _pallas_fits(logits.shape[-1]):
-        return _fwd_pallas(logits, labels, smoothing)
+        n, h = logits.shape
+        return _fwd_pallas(logits, labels, smoothing,
+                           row_block=_tuned_rows(n, h))
     return _fwd_ref(logits, labels, smoothing)
 
 
@@ -181,7 +206,9 @@ def _bwd_vjp(smoothing, padding_idx, half_to_float, res, g):
     g = jnp.where(labels == padding_idx, 0.0,
                   g.astype(jnp.float32))
     if _use_pallas() and _pallas_fits(logits.shape[-1]):
-        dx = _bwd_pallas(g, logits, mlse, labels, smoothing)
+        n, h = logits.shape
+        dx = _bwd_pallas(g, logits, mlse, labels, smoothing,
+                         row_block=_tuned_rows(n, h))
     else:
         dx = _bwd_ref(g, logits, mlse, labels, smoothing)
     return dx, None

@@ -45,10 +45,14 @@ from jax.experimental import pallas as pl
 
 from ..pallas_compat import align_vma as _align_vma
 from ..pallas_compat import sds_with_vma as _sds
-from .fused_layer_norm import (_SUBLANE_ROWS, _VMEM_BUDGET_BYTES,
-                               _use_pallas)
+from ..tune import space as _space
+from ..tune.dispatch import kernel_config as _tuned_config
+from .fused_layer_norm import _use_pallas
 
 __all__ = ["bn_relu_residual", "bn_act_epilogue_ref"]
+
+#: config-cache version of this kernel's blocking scheme (ISSUE 14).
+TUNE_VERSION = 1
 
 
 # -- reference math (jnp fallback + oracle) -----------------------------------
@@ -118,18 +122,27 @@ def _bwd_ref(g, x, mean, invstd, scale, bias, z, relu):
 _ROW_BLOCK = 256
 
 
-def _pick_rows(n_rows: int, c: int, bytes_per_elem: int) -> int:
-    budget = _VMEM_BUDGET_BYTES // (bytes_per_elem * c)
-    rows = min(_ROW_BLOCK, max(_SUBLANE_ROWS,
-                               (budget // _SUBLANE_ROWS) * _SUBLANE_ROWS))
-    return min(rows, n_rows)
+def _pick_rows(n_rows: int, c: int, bytes_per_elem: int,
+               row_block: Optional[int] = None) -> int:
+    # shared VMEM/row-block math (ISSUE 14 satellite): one home in
+    # apex_tpu.tune.space for this kernel, fused_layer_norm, and the
+    # autotuner's constraint checker; row_block is the tuned cap.
+    return _space.pick_rows(n_rows, c, bytes_per_elem,
+                            row_block=row_block or _ROW_BLOCK)
 
 
 def _kernel_fits(c: int, itemsize: int) -> bool:
     """Even the 8-row floor block must fit the scoped-VMEM budget (the
     fused_layer_norm width gate, per-channel edition)."""
     # fwd worst case: x, z, out at itemsize + ~2 fp32 temporaries
-    return _SUBLANE_ROWS * c * (3 * itemsize + 8) <= _VMEM_BUDGET_BYTES
+    return _space.floor_block_fits(c, 3 * itemsize + 8)
+
+
+def tune_bucket(n_rows: int, c: int, itemsize: int, has_z: bool) -> str:
+    """Config-cache shape bucket: rows round to a power of two; channel
+    width, itemsize, and the residual flag (an extra activation-sized
+    operand per block) are exact."""
+    return f"r{_space.pow2_bucket(n_rows)}_c{c}_i{itemsize}_z{int(has_z)}"
 
 
 def _fwd_kernel(x_ref, mean_ref, invstd_ref, w_ref, b_ref, z_ref, out_ref,
@@ -170,10 +183,11 @@ def _as_2d(v, c):
     return jnp.reshape(jnp.asarray(v, jnp.float32), (1, c))
 
 
-def _pallas_fwd(x2d, mean, invstd, scale, bias, z2d, relu, interpret):
+def _pallas_fwd(x2d, mean, invstd, scale, bias, z2d, relu, interpret,
+                row_block=None):
     n, c = x2d.shape
     isz = jnp.dtype(x2d.dtype).itemsize
-    rows = _pick_rows(n, c, 3 * isz + 8)
+    rows = _pick_rows(n, c, 3 * isz + 8, row_block)
     grid = (pl.cdiv(n, rows),)
     affine = scale is not None
     has_z = z2d is not None
@@ -200,10 +214,12 @@ def _pallas_fwd(x2d, mean, invstd, scale, bias, z2d, relu, interpret):
     )(*operands)
 
 
-def _pallas_bwd(g2d, x2d, mean, invstd, scale, bias, z2d, relu, interpret):
+def _pallas_bwd(g2d, x2d, mean, invstd, scale, bias, z2d, relu, interpret,
+                row_block=None):
     n, c = x2d.shape
     isz = jnp.dtype(x2d.dtype).itemsize
-    rows = _pick_rows(n, c, 4 * isz + 12)      # g, x, dx, dz + temporaries
+    rows = _pick_rows(n, c, 4 * isz + 12,      # g, x, dx, dz + temporaries
+                      row_block)
     grid = (pl.cdiv(n, rows),)
     affine = scale is not None
     has_z = z2d is not None
@@ -253,27 +269,27 @@ def _dispatch_pallas(n_rows: int, c: int, impl: Optional[str],
 
 # -- public op with custom VJP ------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
 def _epilogue(x2d, mean, invstd, scale, bias, z2d, relu, use_pallas,
-              interpret):
+              interpret, row_block):
     if use_pallas:
         return _pallas_fwd(x2d, mean, invstd, scale, bias, z2d, relu,
-                           interpret)
+                           interpret, row_block)
     return _fwd_ref(x2d, mean, invstd, scale, bias, z2d, relu)
 
 
 def _epilogue_fwd(x2d, mean, invstd, scale, bias, z2d, relu, use_pallas,
-                  interpret):
+                  interpret, row_block):
     out = _epilogue(x2d, mean, invstd, scale, bias, z2d, relu, use_pallas,
-                    interpret)
+                    interpret, row_block)
     return out, (x2d, mean, invstd, scale, bias, z2d)
 
 
-def _epilogue_bwd(relu, use_pallas, interpret, res, g):
+def _epilogue_bwd(relu, use_pallas, interpret, row_block, res, g):
     x2d, mean, invstd, scale, bias, z2d = res
     if use_pallas:
         dx, dz = _pallas_bwd(g, x2d, mean, invstd, scale, bias, z2d, relu,
-                             interpret)
+                             interpret, row_block)
         # Per-channel reductions recompute the relu mask in jnp — column
         # sums XLA fuses with the kernel's outputs; the activation-sized
         # work stayed in the Pallas pass.
@@ -300,7 +316,8 @@ _epilogue.defvjp(_epilogue_fwd, _epilogue_bwd)
 
 def bn_relu_residual(x, mean, invstd, scale=None, bias=None, z=None,
                      relu=True, impl: Optional[str] = None,
-                     interpret: bool = False):
+                     interpret: bool = False,
+                     row_block: Optional[int] = None):
     """Fused BN epilogue: ``relu((x - mean) * invstd * scale + bias + z)``.
 
     ``x`` is channels-last (``[..., C]``); ``mean``/``invstd`` and the
@@ -318,6 +335,10 @@ def bn_relu_residual(x, mean, invstd, scale=None, bias=None, z=None,
     and ``z`` — statistics computed outside (XLA reductions, psums for
     SyncBatchNorm) receive exact cotangents, so wrapping only the
     epilogue keeps full-BN autodiff correct.
+
+    ``row_block``: explicit kernel row-block cap; left ``None`` the
+    per-device config cache (:mod:`apex_tpu.tune`) is consulted with
+    the hard-coded 256-row default as the fallback.
     """
     c = x.shape[-1]
     n_rows = 1
@@ -330,8 +351,16 @@ def bn_relu_residual(x, mean, invstd, scale=None, bias=None, z=None,
     if scale is not None:
         scale = jnp.ravel(jnp.asarray(scale, jnp.float32))
         bias = jnp.ravel(jnp.asarray(bias, jnp.float32))
-    use_pallas = interpret or _dispatch_pallas(
-        n_rows, c, impl, jnp.dtype(x2d.dtype).itemsize)
+    isz = jnp.dtype(x2d.dtype).itemsize
+    use_pallas = _dispatch_pallas(n_rows, c, impl, isz)
+    if interpret and impl != "jnp":
+        use_pallas = True
+    if use_pallas and row_block is None:
+        cfg = _tuned_config("bn_relu_residual", TUNE_VERSION,
+                            tune_bucket(n_rows, c, isz, z is not None),
+                            params=("row_block",))
+        if cfg:
+            row_block = cfg["row_block"]
     out = _epilogue(x2d, mean, invstd, scale, bias, z2d, bool(relu),
-                    use_pallas, bool(interpret))
+                    use_pallas, bool(interpret), row_block)
     return out.reshape(x.shape)

@@ -38,11 +38,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..pallas_compat import sds_with_vma as _sds
+from ..tune import space as _space
+from ..tune.dispatch import kernel_config as _tuned_config
 
 try:  # TPU-only import; absent on CPU-only installs.
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pltpu = None
+
+#: config-cache version of this kernel's blocking scheme (ISSUE 14) —
+#: bump when the row-block semantics change so persisted tuned configs
+#: for the old scheme stop matching.
+TUNE_VERSION = 1
 
 
 def _use_pallas() -> bool:
@@ -74,8 +81,11 @@ _JNP_MAX_ELEMENTS = 4 * 1024 * 1024
 # 8-row sublane floor (the smallest legal block).  The backward block is
 # the per-element worst case: g, x, dx at the input itemsize plus four
 # fp32 row-major temporaries (3*isz + 16 B/element; see _pick_rows).
-_VMEM_BUDGET_BYTES = int(12e6)
-_SUBLANE_ROWS = 8
+# The math itself lives in apex_tpu.tune.space (ISSUE 14 satellite: one
+# home shared by this kernel, fused_bn_act, and the autotuner's
+# constraint checker); the module-level names stay as aliases.
+_VMEM_BUDGET_BYTES = _space.VMEM_BUDGET_BYTES
+_SUBLANE_ROWS = _space.SUBLANE_ROWS
 
 
 def _kernel_max_width(itemsize: int) -> int:
@@ -85,7 +95,7 @@ def _kernel_max_width(itemsize: int) -> int:
     impl="pallas" rather than OOM Mosaic at compile.  Derived from the
     actual itemsize (ADVICE r5): the old fp32-tuned constant let a
     near-max fp64 width pass the gate with a ~17 MB floor block."""
-    return _VMEM_BUDGET_BYTES // ((3 * itemsize + 16) * _SUBLANE_ROWS)
+    return _space.max_width(3 * itemsize + 16)
 
 
 # fp32 worst case among the supported compute dtypes (~53k columns) —
@@ -167,7 +177,8 @@ def _bwd_input_ref(g2d, x2d, mean, invvar, weight):
 _ROW_BLOCK = 256
 
 
-def _pick_rows(n1: int, n2: int, bytes_per_elem: int) -> int:
+def _pick_rows(n1: int, n2: int, bytes_per_elem: int,
+               row_block: Optional[int] = None) -> int:
     """Row-block size that keeps the kernel's VMEM footprint bounded.
 
     ``bytes_per_elem`` is the per-[rows, n2]-element footprint of the
@@ -176,13 +187,20 @@ def _pick_rows(n1: int, n2: int, bytes_per_elem: int) -> int:
     bf16), the forward x, out plus ~3 fp32 temporaries (2*isz + 12).  A
     fixed 256-row block OOMs scoped VMEM (16 MB) once n2 reaches ~4k
     (measured r5: [32768, 4096] bf16 bwd asked for 20.25 MB); budget
-    ~12 MB and round down to the sublane multiple.
+    ~12 MB and round down to the sublane multiple
+    (:func:`apex_tpu.tune.space.pick_rows`).  ``row_block`` overrides
+    the 256-row cap — the autotuner's knob; the budget clamp below it
+    keeps any tuned value VMEM-legal.
     """
-    budget_rows = _VMEM_BUDGET_BYTES // (bytes_per_elem * n2)
-    rows = min(_ROW_BLOCK, max(_SUBLANE_ROWS,
-                               (budget_rows // _SUBLANE_ROWS)
-                               * _SUBLANE_ROWS))
-    return min(rows, n1)
+    return _space.pick_rows(n1, n2, bytes_per_elem,
+                            row_block=row_block or _ROW_BLOCK)
+
+
+def tune_bucket(n1: int, n2: int, itemsize: int) -> str:
+    """Config-cache shape bucket: rows round up to a power of two (the
+    row block depends only weakly on n1), width and itemsize exact
+    (they set the budget math)."""
+    return f"r{_space.pow2_bucket(n1)}_w{n2}_i{itemsize}"
 
 
 def _fwd_kernel(x_ref, w_ref, b_ref, out_ref, mean_ref, invvar_ref, *,
@@ -216,10 +234,10 @@ def _bwd_kernel(g_ref, x_ref, mean_ref, invvar_ref, w_ref, dx_ref, *, affine):
     dx_ref[:] = ((gf - sum_g - xhat * sum_gx) * invvar).astype(dx_ref.dtype)
 
 
-def _pallas_fwd(x2d, weight, bias, eps):
+def _pallas_fwd(x2d, weight, bias, eps, interpret=False, row_block=None):
     n1, n2 = x2d.shape
     isz = jnp.dtype(x2d.dtype).itemsize
-    rows = _pick_rows(n1, n2, 2 * isz + 12)
+    rows = _pick_rows(n1, n2, 2 * isz + 12, row_block)
     grid = (pl.cdiv(n1, rows),)
     affine = weight is not None
     has_bias = bias is not None
@@ -245,14 +263,16 @@ def _pallas_fwd(x2d, weight, bias, eps):
             _sds((n1, 1), jnp.float32, x2d),
             _sds((n1, 1), jnp.float32, x2d),
         ],
+        interpret=interpret,
     )(x2d, w, b)
     return out, mean[:, 0], invvar[:, 0]
 
 
-def _pallas_bwd_input(g2d, x2d, mean, invvar, weight):
+def _pallas_bwd_input(g2d, x2d, mean, invvar, weight, interpret=False,
+                      row_block=None):
     n1, n2 = x2d.shape
     isz = jnp.dtype(x2d.dtype).itemsize
-    rows = _pick_rows(n1, n2, 3 * isz + 16)
+    rows = _pick_rows(n1, n2, 3 * isz + 16, row_block)
     grid = (pl.cdiv(n1, rows),)
     affine = weight is not None
     w = weight if affine else jnp.zeros((n2,), x2d.dtype)
@@ -269,27 +289,39 @@ def _pallas_bwd_input(g2d, x2d, mean, invvar, weight):
         ],
         out_specs=pl.BlockSpec((rows, n2), lambda i: (i, 0)),
         out_shape=_sds((n1, n2), x2d.dtype, x2d, g2d),
+        interpret=interpret,
     )(g2d, x2d, mean[:, None], invvar[:, None], w)
 
 
 # -- public functional API with custom VJP ------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _layer_norm(x2d, weight, bias, eps, use_pallas):
-    out, _, _ = (_pallas_fwd if use_pallas else _fwd_ref)(x2d, weight, bias, eps)
+def _fwd_impl(x2d, weight, bias, eps, use_pallas, interpret, row_block):
+    if use_pallas:
+        return _pallas_fwd(x2d, weight, bias, eps, interpret, row_block)
+    return _fwd_ref(x2d, weight, bias, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _layer_norm(x2d, weight, bias, eps, use_pallas, interpret, row_block):
+    out, _, _ = _fwd_impl(x2d, weight, bias, eps, use_pallas, interpret,
+                          row_block)
     return out
 
 
-def _layer_norm_fwd(x2d, weight, bias, eps, use_pallas):
-    out, mean, invvar = (_pallas_fwd if use_pallas else _fwd_ref)(
-        x2d, weight, bias, eps)
+def _layer_norm_fwd(x2d, weight, bias, eps, use_pallas, interpret,
+                    row_block):
+    out, mean, invvar = _fwd_impl(x2d, weight, bias, eps, use_pallas,
+                                  interpret, row_block)
     return out, (x2d, weight, bias, mean, invvar)
 
 
-def _layer_norm_bwd(eps, use_pallas, res, g):
+def _layer_norm_bwd(eps, use_pallas, interpret, row_block, res, g):
     x2d, weight, bias, mean, invvar = res
-    dx = (_pallas_bwd_input if use_pallas else _bwd_input_ref)(
-        g, x2d, mean, invvar, weight)
+    if use_pallas:
+        dx = _pallas_bwd_input(g, x2d, mean, invvar, weight, interpret,
+                               row_block)
+    else:
+        dx = _bwd_input_ref(g, x2d, mean, invvar, weight)
     if weight is not None:
         xhat = ((x2d.astype(jnp.float32) - mean[:, None]) * invvar[:, None])
         dw = jnp.sum(g.astype(jnp.float32) * xhat, axis=0).astype(weight.dtype)
@@ -306,27 +338,50 @@ _layer_norm.defvjp(_layer_norm_fwd, _layer_norm_bwd)
 
 
 def fused_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
-                     impl: Optional[str] = None):
+                     impl: Optional[str] = None,
+                     row_block: Optional[int] = None,
+                     interpret: bool = False):
     """Functional fused layer norm (reference ``fused_layer_norm.py:64-68``
     ``fused_layer_norm``/``fused_layer_norm_affine``).
 
     ``impl``: ``None`` (default) picks pallas-vs-jnp by the measured
     in-context crossover (see ``_JNP_MAX_ELEMENTS``); ``"pallas"`` /
     ``"jnp"`` force a path (pallas still requires the TPU backend).
+
+    ``row_block``: explicit row-block cap for the Pallas kernel; left
+    ``None`` the per-device config cache (:mod:`apex_tpu.tune`) is
+    consulted with the hard-coded 256-row default as the fallback.
+    ``interpret=True`` runs the Pallas kernel in interpreter mode (CPU
+    tier-parity tests and tune probes).
     """
     n1, n2 = _compute_n1_n2(x.shape, normalized_shape)
     x2d = x.reshape(n1, n2)
     w = weight.reshape(n2) if weight is not None else None
     b = bias.reshape(n2) if bias is not None else None
-    out = _layer_norm(x2d, w, b, float(eps),
-                      _dispatch_pallas(n1, n2, impl,
-                                       jnp.dtype(x2d.dtype).itemsize))
+    isz = jnp.dtype(x2d.dtype).itemsize
+    # interpret forces the (interpreter-mode) kernel unless the caller
+    # explicitly asked for the jnp reference — the same A/B-probe
+    # contract as quant.quantized_matmul.
+    use_pallas = _dispatch_pallas(n1, n2, impl, isz)
+    if interpret and impl != "jnp":
+        use_pallas = True
+    if use_pallas and row_block is None:
+        cfg = _tuned_config("fused_layer_norm", TUNE_VERSION,
+                            tune_bucket(n1, n2, isz),
+                            params=("row_block",))
+        if cfg:
+            row_block = cfg["row_block"]
+    out = _layer_norm(x2d, w, b, float(eps), use_pallas, bool(interpret),
+                      row_block)
     return out.reshape(x.shape)
 
 
 def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5,
-                            impl: Optional[str] = None):
-    return fused_layer_norm(x, normalized_shape, weight, bias, eps, impl)
+                            impl: Optional[str] = None,
+                            row_block: Optional[int] = None,
+                            interpret: bool = False):
+    return fused_layer_norm(x, normalized_shape, weight, bias, eps, impl,
+                            row_block, interpret)
 
 
 # -- flax module --------------------------------------------------------------

@@ -60,8 +60,15 @@ except ImportError:  # pragma: no cover
 from ..normalization.fused_layer_norm import _use_pallas
 from ..pallas_compat import align_vma as _align_vma
 from ..pallas_compat import sds_with_vma as _sds
+from ..tune.dispatch import kernel_config as _tuned_config
+from ..tune.space import pow2_bucket as _pow2
 
 NEG_INF = -1e30
+
+#: config-cache version of this kernel family's blocking scheme
+#: (ISSUE 14) — covers the forward AND both backward kernels (they
+#: share block_q/block_k); bump when the grid/block semantics change.
+TUNE_VERSION = 1
 # r4 block-size sweep on the v5e (seq 8k causal fwd+bwd, min-of-3):
 # 512x512 18.45 ms, 1024x512 17.50, 512x1024 16.44, 1024x1024 15.75,
 # 2048x512 17.78, 256x256 27.99 — bigger blocks amortize the per-block
@@ -84,6 +91,17 @@ _DEFAULT_BLOCK_K = 1024
 # the same function; passing block_q/block_k explicitly always forces
 # the kernel (the escape hatch, same contract as the bias cap).
 _KERNEL_MIN_KV = 1024
+
+
+def tune_bucket(tq: int, tk: int, d: int, causal: bool, has_bias: bool,
+                windowed: bool) -> str:
+    """Config-cache shape bucket: sequence lengths round up to powers of
+    two (the block sweep's winners are stable within a pow2 band, r4);
+    head_dim, causality, the [B,T,S]-bias flag (extra VMEM residents per
+    block) and the sliding-window flag (bounded grid wants bq == bk) are
+    exact."""
+    return (f"q{_pow2(tq)}_k{_pow2(tk)}_d{d}_c{int(causal)}"
+            f"_b{int(has_bias)}_w{int(windowed)}")
 
 
 def _dispatch_to_jnp(tq, tk, defaults_used):
@@ -850,6 +868,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
             raise ValueError(f"window must be >= 1, got {window}")
     if sm_scale is None:
         sm_scale = d ** -0.5
+    # Plain Python flags for the tune-cache bucket, computed BEFORE the
+    # bias is broadcast/folded below (a per-head [B,H,T,S] bias forces
+    # the jnp path, so the consult never sees the distinction).
+    tune_has_bias = bias is not None
+    tune_windowed = window is not None
     per_head_bias = None
     if bias is not None and bias.ndim == 4:
         # [B, H, T, S] per-head bias: no kernel support — documented jnp
@@ -936,6 +959,24 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                    bias=b4, block_size=bs,
                                    q_offset=q_offset)
+
+    # Dispatch-time autotune consult (ISSUE 14): when the caller left
+    # the blocks at their defaults and the kernel path won, the
+    # per-device config cache may override the hand-picked v5e sweep
+    # constants.  A tuned block that does not tile this exact sequence
+    # (cache written from a different length in the same pow2 bucket)
+    # falls back to the defaults already computed above.  Explicit
+    # block_q/block_k callers — and the jnp path — never consult.
+    if defaults_used:
+        cfg = _tuned_config(
+            "flash_attention", TUNE_VERSION,
+            tune_bucket(tq, tk, d, causal, tune_has_bias, tune_windowed),
+            params=("block_q", "block_k"))
+        if cfg:
+            tbq = _pick_block(tq, cfg["block_q"])
+            tbk = _pick_block(tk, cfg["block_k"])
+            if tbq is not None and tbk is not None:
+                bq, bk = tbq, tbk
 
     qt = q.transpose(0, 2, 1, 3)                         # [B, H, T, D]
     kt = k.transpose(0, 2, 1, 3)

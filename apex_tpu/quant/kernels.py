@@ -42,10 +42,15 @@ from jax.experimental import pallas as pl
 from ..normalization.fused_layer_norm import _use_pallas
 from ..pallas_compat import align_vma as _align_vma
 from ..pallas_compat import sds_with_vma as _sds
+from ..tune.dispatch import kernel_config as _tuned_config
+from ..tune.space import pow2_bucket as _pow2
 
 __all__ = ["amax_to_scale", "quantize", "dequantize", "channel_scale",
            "quantized_matmul", "quantized_matmul_ref", "saturation_count",
            "QMAX"]
+
+#: config-cache version of this kernel's blocking scheme (ISSUE 14).
+TUNE_VERSION = 1
 
 #: symmetric int8 range: quantized values live in [-QMAX, QMAX].
 QMAX = 127.0
@@ -152,11 +157,18 @@ def _qmm_kernel(x_ref, qw_ref, xs_ref, ws_ref, out_ref):
     out_ref[:] = out.astype(out_ref.dtype)
 
 
-def _pallas_qmm(x2d, qw, x_scale, w_scale, out_dtype, interpret):
+def tune_bucket(m: int, k: int, n: int, x_itemsize: int) -> str:
+    """Config-cache shape bucket: K/N exact (they set the VMEM math),
+    rows rounded to a power of two."""
+    return f"m{_pow2(m)}_k{k}_n{n}_i{x_itemsize}"
+
+
+def _pallas_qmm(x2d, qw, x_scale, w_scale, out_dtype, interpret,
+                block_m=None, block_n=None):
     m, k = x2d.shape
     n = qw.shape[1]
-    bm = _pick_block(m, _BLOCK_M, 8)
-    bn = _pick_block(n, _BLOCK_N, 128)
+    bm = _pick_block(m, block_m or _BLOCK_M, 8)
+    bn = _pick_block(n, block_n or _BLOCK_N, 128)
     grid = (pl.cdiv(m, bm), pl.cdiv(n, bn))
     xs2d = jnp.reshape(x_scale.astype(jnp.float32), (1, 1))
     ws2d = jnp.reshape(w_scale.astype(jnp.float32), (1, n))
@@ -198,20 +210,24 @@ def _dispatch_pallas(m: int, k: int, n: int, impl: Optional[str],
 
 # -- public op with custom VJP ------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _qmm(x2d, w2d, x_scale, w_scale, use_pallas, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _qmm(x2d, w2d, x_scale, w_scale, use_pallas, interpret, block_m,
+         block_n):
     qw = quantize(w2d, w_scale[None, :])
     if use_pallas:
-        return _pallas_qmm(x2d, qw, x_scale, w_scale, x2d.dtype, interpret)
+        return _pallas_qmm(x2d, qw, x_scale, w_scale, x2d.dtype, interpret,
+                           block_m, block_n)
     return _matmul_ref(x2d, qw, x_scale, w_scale, x2d.dtype)
 
 
-def _qmm_fwd(x2d, w2d, x_scale, w_scale, use_pallas, interpret):
-    out = _qmm(x2d, w2d, x_scale, w_scale, use_pallas, interpret)
+def _qmm_fwd(x2d, w2d, x_scale, w_scale, use_pallas, interpret, block_m,
+             block_n):
+    out = _qmm(x2d, w2d, x_scale, w_scale, use_pallas, interpret, block_m,
+               block_n)
     return out, (x2d, w2d, x_scale, w_scale)
 
 
-def _qmm_bwd(use_pallas, interpret, res, g):
+def _qmm_bwd(use_pallas, interpret, block_m, block_n, res, g):
     # Straight-through backward in the operands' own (bf16) precision:
     # the quantization is treated as identity, so gradients see the
     # full-precision matmul — the LLM.int8()/FP8-training recipe.  The
@@ -229,7 +245,9 @@ _qmm.defvjp(_qmm_fwd, _qmm_bwd)
 
 def quantized_matmul(x, w, *, x_scale, w_scale=None,
                      impl: Optional[str] = None,
-                     interpret: bool = False):
+                     interpret: bool = False,
+                     block_m: Optional[int] = None,
+                     block_n: Optional[int] = None):
     """int8 quantized matmul ``x @ w`` with a dequantize-fused epilogue.
 
     ``x``: ``[..., K]`` activations (bf16/fp32); ``w``: ``[K, N]``
@@ -245,6 +263,11 @@ def quantized_matmul(x, w, *, x_scale, w_scale=None,
     Pallas kernel in interpreter mode (CPU tier-parity tests);
     ``impl="jnp"`` wins over it — that combination is the explicit
     "reference on this exact call" A/B probe.
+
+    ``block_m``/``block_n``: explicit kernel tile overrides; left
+    ``None`` the per-device config cache (:mod:`apex_tpu.tune`) is
+    consulted with the hard-coded 256x256 defaults as the fallback
+    (a tuned tile that fails the VMEM fit gate is ignored).
 
     Differentiable in ``x`` and ``w`` (straight-through, bf16 backward);
     the scales receive zero cotangents.
@@ -262,9 +285,20 @@ def quantized_matmul(x, w, *, x_scale, w_scale=None,
     # (interpreter mode) only when impl doesn't explicitly ask for the
     # jnp reference — impl="jnp" + interpret=True is the A/B probe
     # "reference on this exact call" and must stay honored.
-    use_pallas = _dispatch_pallas(
-        x2d.shape[0], k, w.shape[1], impl, jnp.dtype(x2d.dtype).itemsize)
+    isz = jnp.dtype(x2d.dtype).itemsize
+    use_pallas = _dispatch_pallas(x2d.shape[0], k, w.shape[1], impl, isz)
     if interpret and impl != "jnp":
         use_pallas = True
-    out = _qmm(x2d, w, x_scale, w_scale, use_pallas, bool(interpret))
+    if use_pallas and block_m is None and block_n is None:
+        cfg = _tuned_config("quantized_matmul", TUNE_VERSION,
+                            tune_bucket(x2d.shape[0], k, w.shape[1], isz),
+                            params=("block_m", "block_n"))
+        if cfg:
+            tbm = _pick_block(x2d.shape[0], cfg.get("block_m", _BLOCK_M), 8)
+            tbn = _pick_block(w.shape[1], cfg.get("block_n", _BLOCK_N), 128)
+            if _kernel_fits(tbm, tbn, k, isz):
+                block_m = cfg.get("block_m")
+                block_n = cfg.get("block_n")
+    out = _qmm(x2d, w, x_scale, w_scale, use_pallas, bool(interpret),
+               block_m, block_n)
     return out.reshape(*lead, w.shape[1])

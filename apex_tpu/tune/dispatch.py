@@ -1,0 +1,102 @@
+"""Dispatch-time config consult — the hot-path half of the autotuner.
+
+Every registered kernel calls :func:`kernel_config` at TRACE time (the
+block constants are Python ints baked into the program, so this runs
+once per compiled signature, never per step) when the caller left the
+block arguments at their defaults and the Pallas path was chosen.  A
+hit returns the persisted per-device config; a miss — or ANY cache
+problem — returns None and the kernel uses its hard-coded defaults,
+so the tuner can only ever make dispatch faster, never break it.
+
+CPU/interpret paths never tune: this module only *reads*; measurement
+lives in :mod:`apex_tpu.tune.measure` and runs explicitly (CLI or API).
+
+Telemetry: each consult refreshes the ``tuned_kernel_pct`` gauge on the
+active recorder's metrics registry (the fraction of distinct consulted
+kernels whose latest lookup hit the cache — exported live through the
+existing Prometheus path), and the FIRST consult of each (kernel,
+bucket) emits one ``tune`` event with ``phase="dispatch"`` so a
+timeline shows which kernels ran tuned and which fell back.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from . import store
+
+__all__ = ["kernel_config", "dispatch_stats", "reset_stats"]
+
+_lock = threading.Lock()
+#: latest consult outcome per kernel name (True = tuned config served)
+_LATEST: Dict[str, bool] = {}
+#: cumulative consult counters per kernel
+_COUNTS: Dict[str, Dict[str, int]] = {}
+#: (kernel, bucket) pairs already announced via a ``tune`` event
+_ANNOUNCED: set = set()
+
+
+def kernel_config(kernel: str, version: int, bucket: str, *,
+                  params: Tuple[str, ...] = ()
+                  ) -> Optional[Dict[str, int]]:
+    """The tuned config for ``(device kind, kernel, version, bucket)``
+    or None (use the hard-coded defaults).  ``params`` names the config
+    keys the kernel understands; an entry whose key set differs — extra
+    keys from a hand-edited file or a future kernel's richer space, OR
+    missing keys from a partially-written entry — is rejected as a miss
+    rather than passed through to ``pallas_call`` (kernels index the
+    config unconditionally, so a one-sided check would let a partial
+    entry crash dispatch).  Never raises.
+    """
+    cfg = store.lookup(kernel, version, bucket)
+    if cfg is not None and params:
+        # bool is an int subclass: a hand-edited JSON `true` would pass
+        # a bare isinstance(int) check and reach _pick_block as 1
+        if set(cfg) != set(params) \
+                or not all(isinstance(v, int)
+                           and not isinstance(v, bool) and v > 0
+                           for v in cfg.values()):
+            cfg = None
+    hit = cfg is not None
+    with _lock:
+        _LATEST[kernel] = hit
+        c = _COUNTS.setdefault(kernel, {"hits": 0, "misses": 0})
+        c["hits" if hit else "misses"] += 1
+        pct = 100.0 * sum(_LATEST.values()) / len(_LATEST)
+        announce = (kernel, bucket) not in _ANNOUNCED
+        if announce:
+            _ANNOUNCED.add((kernel, bucket))
+    try:
+        from ..telemetry import get_recorder
+        rec = get_recorder()
+        if rec is not None:
+            rec.metrics.gauge("tuned_kernel_pct").set(pct)
+            if announce:
+                rec.event("tune", phase="dispatch", kernel=kernel,
+                          bucket=bucket, hit=hit,
+                          config=(dict(cfg) if cfg else None))
+    except Exception:           # telemetry must never break dispatch
+        pass
+    return cfg
+
+
+def dispatch_stats() -> Dict[str, object]:
+    """Consult counters: ``{"tuned_kernel_pct", "by_kernel": {name:
+    {"hits", "misses", "tuned"}}}`` — what the gauge reports, readable
+    without a recorder (the examples' exit line, tests)."""
+    with _lock:
+        by = {k: {"hits": v["hits"], "misses": v["misses"],
+                  "tuned": _LATEST.get(k, False)}
+              for k, v in _COUNTS.items()}
+        pct = (100.0 * sum(_LATEST.values()) / len(_LATEST)
+               if _LATEST else None)
+    return {"tuned_kernel_pct": pct, "by_kernel": by}
+
+
+def reset_stats() -> None:
+    """Clear consult counters/announcements (test isolation)."""
+    with _lock:
+        _LATEST.clear()
+        _COUNTS.clear()
+        _ANNOUNCED.clear()

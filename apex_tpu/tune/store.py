@@ -1,0 +1,232 @@
+"""Persistent per-device kernel-config cache (ISSUE 14 tentpole, layer 3).
+
+One JSON file, ``tune_configs.json``, living beside the XLA compilation
+cache (:func:`apex_tpu.cache.enable` points both stores at the same
+directory; without it the default is ``~/.cache/apex_tpu``, and
+``APEX_TPU_TUNE_CACHE`` overrides either).  Entries are keyed by
+
+    ``(device kind, kernel name, kernel version, shape bucket)``
+
+so a cache tuned on a v5e never feeds a v4, and a kernel that changes
+its blocking math bumps its ``TUNE_VERSION`` and every stale entry
+silently stops matching (:func:`prune_stale` garbage-collects them).
+
+Failure policy — the cache must never be able to break a training run:
+
+* a corrupt or partially-written file **falls back to defaults
+  loudly-once** (one stderr line per path per process, then silence);
+* every read path swallows unexpected errors and returns "no entry";
+* writes are read-modify-write with an atomic ``os.replace`` so a
+  concurrent reader never sees a torn file.
+
+The in-memory view is memoized per path — the dispatch-time consult
+(:mod:`apex_tpu.tune.dispatch`) costs two dict lookups after the first
+load.  :func:`load` with ``reload=True`` drops the memo (what a process
+restart does implicitly; the cache-lifecycle tests use it to prove the
+persisted file alone reproduces the lookups).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["CACHE_FILENAME", "SCHEMA", "cache_path", "set_default_dir",
+           "device_kind", "load", "lookup", "put", "entries",
+           "prune_stale", "key_for"]
+
+CACHE_FILENAME = "tune_configs.json"
+#: schema of the on-disk file; a future major is treated as corrupt
+#: (defaults-with-warning) rather than mis-read.
+SCHEMA = 1
+
+_lock = threading.Lock()
+_STATE: Dict[str, Any] = {
+    "dir": None,          # set_default_dir() override (cache.enable)
+    "memo_path": None,    # path the memoized data was loaded from
+    "memo": None,         # {"schema": 1, "entries": {...}}
+    "warned": set(),      # paths already warned about (loudly-once)
+}
+
+
+def set_default_dir(path: Optional[str]) -> None:
+    """Point the default cache location at ``path`` (a directory).
+    :func:`apex_tpu.cache.enable` calls this so the tune configs land
+    beside the persistent XLA compilation cache.  Drops the memo when
+    the location actually changes."""
+    with _lock:
+        path = os.path.abspath(os.path.expanduser(path)) if path else None
+        if _STATE["dir"] != path:
+            _STATE["dir"] = path
+            _STATE["memo_path"] = None
+            _STATE["memo"] = None
+
+
+def cache_path(path: Optional[str] = None) -> str:
+    """Resolve the cache file path: an explicit ``path`` (file, or a
+    directory to hold :data:`CACHE_FILENAME`) wins, then the
+    ``APEX_TPU_TUNE_CACHE`` env var, then the directory installed by
+    :func:`set_default_dir`, then ``~/.cache/apex_tpu``."""
+    cand = path or os.environ.get("APEX_TPU_TUNE_CACHE") or _STATE["dir"] \
+        or os.path.join("~", ".cache", "apex_tpu")
+    cand = os.path.abspath(os.path.expanduser(cand))
+    if os.path.isdir(cand) or not cand.endswith(".json"):
+        cand = os.path.join(cand, CACHE_FILENAME)
+    return cand
+
+
+def device_kind() -> str:
+    """Normalized accelerator kind of the default backend (the cache key
+    prefix): ``jax.devices()[0].device_kind`` with spaces collapsed —
+    e.g. ``TPU_v5_lite`` — or ``cpu`` when no accelerator (or no jax)
+    is reachable."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", None) or dev.platform
+        return str(kind).strip().replace(" ", "_")
+    except Exception:
+        return "cpu"
+
+
+def key_for(kernel: str, version: int, bucket: str,
+            dev_kind: Optional[str] = None) -> str:
+    """The flat entry key: ``device|kernel|vN|bucket``."""
+    return "|".join([dev_kind or device_kind(), kernel,
+                     f"v{int(version)}", bucket])
+
+
+def _warn_once(path: str, msg: str) -> None:
+    if path in _STATE["warned"]:
+        return
+    _STATE["warned"].add(path)
+    print(f"apex_tpu.tune: {msg} ({path}) — falling back to built-in "
+          f"default configs", file=sys.stderr)
+
+
+def _read_file(path: str) -> Dict[str, Any]:
+    """Parse the cache file; corrupt/partial/future-schema content is
+    reported loudly-once and treated as empty."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except FileNotFoundError:
+        return {"schema": SCHEMA, "entries": {}}
+    except (OSError, ValueError) as e:
+        _warn_once(path, f"config cache unreadable/corrupt "
+                         f"({type(e).__name__}: {e})")
+        return {"schema": SCHEMA, "entries": {}}
+    if not isinstance(raw, dict) or not isinstance(raw.get("entries"), dict):
+        _warn_once(path, "config cache has no entries table")
+        return {"schema": SCHEMA, "entries": {}}
+    if int(raw.get("schema", 0)) > SCHEMA:
+        _warn_once(path, f"config cache schema {raw.get('schema')} is "
+                         f"newer than this build understands ({SCHEMA})")
+        return {"schema": SCHEMA, "entries": {}}
+    # partial entries (no config dict) are skipped, not fatal
+    ents = {}
+    for key, ent in raw["entries"].items():
+        if isinstance(ent, dict) and isinstance(ent.get("config"), dict):
+            ents[key] = ent
+    if len(ents) != len(raw["entries"]):
+        _warn_once(path, f"{len(raw['entries']) - len(ents)} partial "
+                         f"config-cache entr(ies) skipped")
+    return {"schema": SCHEMA, "entries": ents}
+
+
+def load(path: Optional[str] = None, *, reload: bool = False
+         ) -> Dict[str, Any]:
+    """The cache's in-memory view (memoized per path).  ``reload=True``
+    re-reads from disk — the restart-survival probe."""
+    p = cache_path(path)
+    with _lock:
+        if not reload and _STATE["memo_path"] == p \
+                and _STATE["memo"] is not None:
+            return _STATE["memo"]
+        data = _read_file(p)
+        _STATE["memo_path"], _STATE["memo"] = p, data
+        return data
+
+
+def lookup(kernel: str, version: int, bucket: str, *,
+           dev_kind: Optional[str] = None,
+           path: Optional[str] = None) -> Optional[Dict[str, int]]:
+    """The tuned config for this key, or None (miss, stale version,
+    wrong device kind, corrupt cache — all collapse to the defaults
+    fallback).  Never raises."""
+    try:
+        data = load(path)
+        ent = data["entries"].get(key_for(kernel, version, bucket, dev_kind))
+        return dict(ent["config"]) if ent else None
+    except Exception:           # the cache must never break dispatch
+        return None
+
+
+def put(kernel: str, version: int, bucket: str,
+        config: Dict[str, int], *,
+        meta: Optional[Dict[str, Any]] = None,
+        dev_kind: Optional[str] = None,
+        path: Optional[str] = None) -> str:
+    """Persist one tuned config (read-modify-write + atomic replace);
+    returns the entry key.  The memo is refreshed in place so the
+    writing process dispatches its own result immediately."""
+    p = cache_path(path)
+    with _lock:
+        data = _read_file(p)
+        key = key_for(kernel, version, bucket, dev_kind)
+        data["entries"][key] = {
+            "kernel": kernel, "version": int(version), "bucket": bucket,
+            "device_kind": dev_kind or device_kind(),
+            "config": {k: v for k, v in config.items()},
+            "meta": dict(meta or {}),
+        }
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, p)
+        _STATE["memo_path"], _STATE["memo"] = p, data
+        return key
+
+
+def entries(path: Optional[str] = None,
+            dev_kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All cached entries (optionally filtered to one device kind),
+    sorted by key — the CLI's ``show`` table."""
+    data = load(path)
+    out = []
+    for key in sorted(data["entries"]):
+        ent = dict(data["entries"][key])
+        if dev_kind and ent.get("device_kind") != dev_kind:
+            continue
+        ent["key"] = key
+        out.append(ent)
+    return out
+
+
+def prune_stale(current_versions: Dict[str, int],
+                path: Optional[str] = None) -> int:
+    """Drop entries whose kernel appears in ``current_versions`` with a
+    DIFFERENT version (the bump-invalidation garbage collector; stale
+    entries already never match lookups).  Returns how many were
+    removed."""
+    p = cache_path(path)
+    with _lock:
+        data = _read_file(p)
+        stale = [k for k, e in data["entries"].items()
+                 if e.get("kernel") in current_versions
+                 and int(e.get("version", -1))
+                 != int(current_versions[e["kernel"]])]
+        for k in stale:
+            del data["entries"][k]
+        if stale:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            tmp = p + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, p)
+        _STATE["memo_path"], _STATE["memo"] = p, data
+        return len(stale)

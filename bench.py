@@ -154,11 +154,17 @@ _WINDOW_GAP_GATE_PCT = 25.0
 # steady/best-window RATIO floors (the inverse view of the gap gate —
 # "steady demonstrates at least this fraction of the chip's own best
 # window"; with AOT warmup killing the step-0/1 compiles the steady
-# clock has no excuse left), and a ResNet MFU floor STRICTLY above the
-# r05 value (25.1% of measured matmul peak) so the fused conv epilogues
-# must show up as device time, not just as code.
+# clock has no excuse left), and a ResNet MFU floor so the fused conv
+# epilogues must show up as device time, not just as code.  ISSUE 14
+# switched the MFU floor from the static >26% to a RATCHET against the
+# previous round's committed bench via prof.regress (name-inferred
+# higher-is-better, the ratchet tolerance below + regress's 2-pt-point
+# slack for pct metrics): each release must hold — and can only raise —
+# the measured floor.  The static constant remains as the backstop when
+# no comparable previous summary exists.
 _STEADY_OVER_BEST_FLOORS = {"imagenet": 0.75, "dcgan": 0.75}
 _RESNET_MFU_FLOOR_PCT = 26.0
+_RESNET_MFU_RATCHET_TOL_PCT = 5.0
 
 # DCGAN steady-rate floor (ISSUE 3 acceptance): >= 3x its r05 value
 # (4.67 it/s, the imperative 10-dispatch/iter loop) — the pipelined
@@ -1690,6 +1696,67 @@ def _bench_quant(on_tpu):
     return out
 
 
+def _bench_tune(on_tpu, ledger=None):
+    """ISSUE 14 self-validation: the kernel autotuner end to end.
+
+    For every registered kernel (flash_attention fwd+bwd,
+    fused_layer_norm, bn_relu_residual, xentropy, quantized_matmul):
+    search the config space on this backend (real device timing on
+    chip; interpreter-mode probe on CPU so the whole machinery still
+    runs in CI), candidate priority driven by the freshest resnet
+    roofline ``ledger`` when one was harvested this run.  Recorded per
+    kernel: the winning config, default-vs-tuned ms, and
+    ``tuned_over_default`` — gated <= 1.0 in main() on EVERY kernel
+    (the fallback guarantee: the default config is always a candidate,
+    so tuning can only ever match or beat it).  The persisted cache is
+    then re-read from disk with the in-memory memo dropped (the
+    process-restart probe) and every kernel's lookup must hit.
+    """
+    import tempfile
+
+    from apex_tpu.tune import measure, registry, store
+
+    registry.load_builtin()
+    cache_dir = tempfile.mkdtemp(prefix="apex_tpu_bench_tune_")
+    cache_path = os.path.join(cache_dir, "tune_configs.json")
+    out = {"kernels": {}, "cache_path": cache_path,
+           "device_kind": store.device_kind(),
+           "ledger_driven": ledger is not None}
+    iters, reps = (5, 3) if on_tpu else (1, 1)
+    lookups = []
+    for spec in registry.all_specs():
+        bound = (measure.bound_from_ledger(ledger, spec)
+                 if ledger else None)
+        res = measure.tune_kernel(spec, bound=bound,
+                                  interpret=not on_tpu,
+                                  iters=iters, reps=reps,
+                                  path=cache_path)
+        out["kernels"][spec.name] = {
+            "bucket": res.bucket,
+            "bound": res.bound,
+            "config": res.config,
+            "default_config": res.default_config,
+            "default_ms": res.default_ms,
+            "tuned_ms": res.best_ms,
+            "tuned_over_default": res.tuned_over_default,
+            "candidates": res.candidates,
+            "rejected_constraint": res.rejected_constraint,
+            "rejected_oracle": res.rejected_oracle,
+            "truncated": res.truncated,
+            "source": res.source,
+        }
+        lookups.append((spec.name, spec.version, res.bucket))
+    # restart-survival probe: only the persisted file may answer
+    store.load(cache_path, reload=True)
+    out["persisted_ok"] = all(
+        store.lookup(name, ver, bucket, path=cache_path) is not None
+        for name, ver, bucket in lookups)
+    out["max_tuned_over_default"] = max(
+        (k["tuned_over_default"] for k in out["kernels"].values()
+         if k["tuned_over_default"] is not None), default=None)
+    return out
+
+
 def _bench_examples(on_tpu):
     """Execute the flagship example entry points and distill their own
     printed metrics.  Gates: the run completed, every printed loss is
@@ -2529,6 +2596,28 @@ def main():
             f"(dequant epilogue unfused, or the dispatch gate routed a "
             f"probe-sized matmul to jnp); refusing to report.")
 
+    # ISSUE 14: the kernel autotuner, ledger-driven by the resnet
+    # roofline harvested above when present.
+    extra["tune"] = tn = _bench_tune(
+        on_tpu, ledger=(extra.get("resnet50") or {}).get("roofline"))
+    for kname, krow in tn["kernels"].items():
+        tod = krow.get("tuned_over_default")
+        if tod is not None and tod > 1.0:
+            raise SystemExit(
+                f"BENCH SELF-CHECK FAILED: tuned {kname} config "
+                f"{krow['config']} ran {tod}x the default "
+                f"{krow['default_config']} — the default config is "
+                f"always a candidate, so the tuner can never pick a "
+                f"slower winner (fallback guarantee broken: the "
+                f"measurement or the oracle gate regressed); refusing "
+                f"to report.")
+    if not tn["persisted_ok"]:
+        raise SystemExit(
+            "BENCH SELF-CHECK FAILED: tuned configs did not survive the "
+            "process-restart probe (cache re-read from disk missed at "
+            "least one (device kind, kernel, version, bucket) key) — "
+            "the persistent tune cache is broken; refusing to report.")
+
     # Self-validation, same contract as the MFU gates above: a steady
     # rate far below the example's own best window means the hot loop is
     # stalling on dispatch/syncs again (the exact regression class the
@@ -2562,11 +2651,13 @@ def main():
                     f"(floor {floor}) — the warm-start engine (AOT "
                     f"warmup / persistent cache) or the hot loop's "
                     f"dispatch path has regressed; refusing to report.")
-        # ResNet MFU floor (ISSUE 7): strictly above the r05 25.1% —
-        # the fused conv epilogues + NHWC GroupBN must move the
-        # measured device rate, not just exist.  Checked on the
-        # analytic-FLOPs measure (the r05 baseline's definition) and on
-        # the harvested roofline ledger when present.
+        # ResNet MFU ratchet (ISSUE 14, replacing ISSUE 7's static
+        # >26% floor): each round's measured MFU is gated against the
+        # PREVIOUS committed bench via prof.regress — the same
+        # name-inferred higher-is-better differ CI already runs, so
+        # the floor rises automatically with every improvement instead
+        # of being re-legislated by hand.  With no comparable previous
+        # summary the static constant remains as the backstop.
         resnet_mfus = {
             "mfu_o2_vs_measured_pct":
                 extra["resnet50"].get("mfu_o2_vs_measured_pct"),
@@ -2574,14 +2665,63 @@ def main():
                 ((extra["resnet50"].get("roofline") or {}).get("total")
                  or {}).get("mfu_pct"),
         }
-        for mfu_name, mfu_val in resnet_mfus.items():
-            if mfu_val is not None and mfu_val <= _RESNET_MFU_FLOOR_PCT:
+        prev_bench = _load_prev_bench() or {}
+        prev_mfus = {
+            "mfu_o2_vs_measured_pct":
+                (prev_bench.get("resnet50") or {}).get(
+                    "mfu_o2_vs_measured_pct"),
+            "roofline.total.mfu_pct":
+                (((prev_bench.get("resnet50") or {}).get("roofline")
+                  or {}).get("total") or {}).get("mfu_pct"),
+        }
+        from apex_tpu.prof import regress as _regress
+        # The static floor stays the ratchet's LOWER BOUND: re-basing on
+        # the raw previous value each round would let the 5%+2pt
+        # allowance compound downward release over release (30 -> 26.5
+        # -> 23.2 ... each passing individually).  base = max(prev,
+        # floor) bounds any drift inside the floor's own tolerance band
+        # while genuine improvements keep raising the bar.
+        ratchet_base = {k: max(v, _RESNET_MFU_FLOOR_PCT)
+                        for k, v in prev_mfus.items()
+                        if v is not None and resnet_mfus.get(k) is not None}
+        if ratchet_base:
+            diff = _regress.diff_summaries(
+                {"resnet50": ratchet_base},
+                {"resnet50": {k: resnet_mfus[k] for k in ratchet_base}},
+                default_tol_pct=_RESNET_MFU_RATCHET_TOL_PCT)
+            if diff["regressions"]:
+                rows = "; ".join(
+                    f"{e['metric']} {e['base']}% -> {e['cur']}%"
+                    for e in diff["regressions"])
                 raise SystemExit(
-                    f"BENCH SELF-CHECK FAILED: ResNet-50 O2 {mfu_name} "
-                    f"{mfu_val}% is not above the {_RESNET_MFU_FLOOR_PCT}% "
-                    f"floor (r05 measured 25.1%) — the conv-path fusion "
-                    f"engine (bn_relu_residual epilogues, fused loss) is "
-                    f"not reaching the hot path; refusing to report.")
+                    f"BENCH SELF-CHECK FAILED: ResNet-50 O2 MFU fell "
+                    f"below the previous round's ratchet ({rows}; tol "
+                    f"{_RESNET_MFU_RATCHET_TOL_PCT}% + pct-point "
+                    f"slack) — the conv-path fusion engine or the tuned "
+                    f"kernel configs regressed the measured device "
+                    f"rate; refusing to report.")
+            extra["resnet50"]["mfu_ratchet"] = {
+                "base": ratchet_base,
+                "tol_pct": _RESNET_MFU_RATCHET_TOL_PCT,
+                "improvements": len(diff["improvements"]),
+            }
+        # The static floor stays a HARD lower bound on every current
+        # metric, ratcheted or not: the ratchet's tolerance band sits
+        # below its base, so without this a sequence of
+        # individually-passing rounds could still decay to ~floor*0.95
+        # - slack and camp there — and a metric whose baseline went
+        # missing (failed prev harvest) must never lose gating at all.
+        for mfu_name, mfu_val in resnet_mfus.items():
+            if mfu_val is None:
+                continue
+            if mfu_val <= _RESNET_MFU_FLOOR_PCT:
+                raise SystemExit(
+                    f"BENCH SELF-CHECK FAILED: ResNet-50 O2 "
+                    f"{mfu_name} {mfu_val}% is not above the "
+                    f"{_RESNET_MFU_FLOOR_PCT}% hard floor (the ratchet "
+                    f"only ever RAISES the bar from here) — the "
+                    f"conv-path fusion engine is not reaching the "
+                    f"hot path; refusing to report.")
         # Absolute DCGAN floor (ISSUE 3): a window-gap gate alone can't
         # catch "steady AND best-window both collapsed" — pin steady to
         # >= 3x the r05 imperative baseline.
@@ -2732,6 +2872,11 @@ def main():
                 extra["quant"]["kv"].get("capacity_ratio")),
             "quant_serving_tokens_per_s_int8kv": (
                 extra["quant"]["kv"]["serving_int8"].get("tokens_per_s")),
+            "tune_max_tuned_over_default": (
+                extra["tune"].get("max_tuned_over_default")),
+            "tune_kernels_persisted": (
+                len(extra["tune"]["kernels"])
+                if extra["tune"].get("persisted_ok") else 0),
             "telemetry_overhead_ratio": (
                 extra["telemetry"].get("overhead_ratio")),
             "telemetry_step_p50_ms": (

@@ -75,6 +75,22 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip_cpu)
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _isolated_tune_cache(tmp_path_factory):
+    """Hermetic suite vs the kernel autotuner (ISSUE 14): registered
+    kernels consult the per-device tune config cache at dispatch time,
+    whose default location is ``~/.cache/apex_tpu`` — a developer who
+    ran ``python -m apex_tpu.tune`` locally would otherwise have every
+    interpret-mode kernel test silently dispatch THEIR cached blocks
+    instead of the shipped defaults.  Point the env override at an
+    empty per-session tmpdir (an explicit APEX_TPU_TUNE_CACHE — e.g. an
+    on-chip validation run exercising a real cache — still wins)."""
+    if not os.environ.get("APEX_TPU_TUNE_CACHE"):
+        os.environ["APEX_TPU_TUNE_CACHE"] = str(
+            tmp_path_factory.mktemp("tune_cache") / "tune_configs.json")
+    yield
+
+
 @pytest.fixture
 def cpu_mesh():
     from jax.sharding import Mesh

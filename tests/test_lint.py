@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J014 a known-bad snippet
+1. fixture self-tests — for every rule J001-J015 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -1370,5 +1370,73 @@ def test_j014_is_advisory_and_waivable():
     def step_fn(state, batch):
         x = batch["x"]
         return quant.quantized_matmul(x, state["w"], x_scale=jnp.max(jnp.abs(x)) / 127.0)  # jaxlint: disable=J014 -- sanctioned dynamic-range probe for the calibration sweep
+    """
+    assert _codes(waived) == []
+
+
+# -- J015: literal block-size overrides at kernel call sites (ISSUE 14) -------
+
+def test_j015_flags_literal_block_overrides():
+    bad = """
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    def step_fn(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=512,
+                               block_k=512)
+    """
+    assert _codes(bad) == ["J015"]
+
+
+def test_j015_flags_every_tuned_kernel_kwarg():
+    bad = """
+    from apex_tpu import normalization, quant
+    from apex_tpu.normalization.fused_bn_act import bn_relu_residual
+
+    def step_fn(x, w, mean, invstd, calib):
+        a = normalization.fused_layer_norm(x, (768,), row_block=64)
+        b = bn_relu_residual(x, mean, invstd, row_block=32)
+        c = quant.quantized_matmul(x, w, x_scale=calib.s, block_m=128,
+                                   block_n=256)
+        return a, b, c
+    """
+    findings = lint_source(textwrap.dedent(bad), "apex_tpu/fixture.py")
+    # one finding per call site (dedup is line-scoped, like waivers —
+    # block_m/block_n on one call collapse into a single report)
+    assert [f.rule for f in findings] == ["J015"] * 3
+
+
+def test_j015_variables_and_tuned_dispatch_pass():
+    ok = """
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    def sweep(q, k, v, blk, cfg):
+        # a measured variable / config-derived block is the sanctioned
+        # escape hatch; defaults dispatch through the tune cache
+        a = flash_attention(q, k, v, causal=True, block_q=blk,
+                            block_k=cfg["block_k"])
+        b = flash_attention(q, k, v, causal=True)
+        return a, b
+    """
+    assert _codes(ok) == []
+
+
+def test_j015_only_fires_on_tunable_kernels():
+    ok = """
+    def step_fn(q, k, v):
+        # block-ish kwargs on arbitrary functions are not findings
+        return my_custom_op(q, k, v, block_q=512, row_block=64)
+    """
+    assert _codes(ok) == []
+
+
+def test_j015_is_advisory_and_waivable():
+    from tools.jaxlint.linter import Finding
+
+    assert Finding("p", 1, 0, "J015", "m").advisory
+    waived = """
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    def reference_probe(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=1024, block_k=1024)  # jaxlint: disable=J015 -- documented reference path: pins the r4 sweep winner as the A/B baseline
     """
     assert _codes(waived) == []

@@ -63,6 +63,9 @@ PAGES = {
                 "apex_tpu.serving.kv_cache", "apex_tpu.serving.hotswap"],
     "quant": ["apex_tpu.quant", "apex_tpu.quant.kernels",
               "apex_tpu.quant.calibrate", "apex_tpu.quant.layers"],
+    "tune": ["apex_tpu.tune", "apex_tpu.tune.registry",
+             "apex_tpu.tune.measure", "apex_tpu.tune.store",
+             "apex_tpu.tune.dispatch", "apex_tpu.tune.space"],
 }
 
 

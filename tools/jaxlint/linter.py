@@ -1,6 +1,6 @@
 """jaxlint core — AST rules, waiver handling, and the lint engine.
 
-Rules J001–J013 tuned to this codebase's failure modes (the ones that are
+Rules J001–J015 tuned to this codebase's failure modes (the ones that are
 invisible to pytest and surface as 10x dispatch-floor regressions in
 ``bench.py``):
 
@@ -107,6 +107,17 @@ invisible to pytest and surface as 10x dispatch-floor regressions in
   dispatch and silently changes the numerics the CONVERGENCE_QUANT
   gate certified.  ``w_scale`` is exempt — weights are exact at trace
   time, per-step channel scales are the correct recipe (ISSUE 13).
+* **J015** (advisory) literal block-size overrides at Pallas-kernel
+  call sites: a tunable kernel exposing block params
+  (``flash_attention`` / ``bn_relu_residual`` / ``fused_layer_norm`` /
+  ``quantized_matmul``) invoked with an integer LITERAL for
+  ``block_q``/``block_k``/``block_m``/``block_n``/``row_block``.  The
+  literal freezes one sweep's winner for every device kind and shape,
+  bypassing the per-device config cache the tune registry dispatches
+  through (``python -m apex_tpu.tune``, ISSUE 14) — leave the blocks
+  at their defaults (cache-consulted) or pass a measured variable.
+  Waive where the literal IS the documented reference path (a sweep
+  tool enumerating configs, an A/B probe pinning one side).
 
 Waivers: ``# jaxlint: disable=J001 -- reason`` on the offending line
 suppresses the named rule(s) there; ``# jaxlint: disable-file=J004 --
@@ -165,11 +176,16 @@ RULES: Dict[str, str] = {
             "per-tensor activation scale should come from a FROZEN "
             "apex_tpu.quant calibration, not be re-derived inside the "
             "step; advisory)",
+    "J015": "Pallas kernel invoked with a literal block-size override "
+            "(block_q/block_k/block_m/block_n/row_block) instead of "
+            "dispatching through the tune registry/config cache — the "
+            "literal freezes one device's sweep winner for every "
+            "device kind (python -m apex_tpu.tune; advisory)",
 }
 
 #: Rules reported as advice, not errors: the CLI exits 0 when only
 #: advisory findings remain, and ``Finding.advisory`` marks them.
-ADVISORY_RULES: Set[str] = {"J011", "J013", "J014"}
+ADVISORY_RULES: Set[str] = {"J011", "J013", "J014", "J015"}
 
 # Functions whose *contract* is the host boundary: serialization must
 # materialize host values, so J001 does not fire inside them.  Everything
@@ -1044,6 +1060,45 @@ def _check_j014(tree: ast.Module, path: str) -> List[Finding]:
     return findings
 
 
+# -- J015: literal block-size overrides at tunable-kernel call sites ----------
+
+#: call-name leaves of the registered tunable kernels that EXPOSE a
+#: block override (xentropy is cache-tuned too but its public function
+#: takes no block kwarg, so no literal can appear at a working call
+#: site — listing it would document a parameter that does not exist)
+_J015_KERNEL_CALLS = {"flash_attention", "bn_relu_residual",
+                      "fused_layer_norm", "fused_layer_norm_affine",
+                      "quantized_matmul"}
+#: the tuned block-size parameters across the kernel family
+_J015_BLOCK_KWARGS = {"block_q", "block_k", "block_m", "block_n",
+                      "row_block"}
+
+
+def _check_j015(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name or name.split(".")[-1] not in _J015_KERNEL_CALLS:
+            continue
+        for kw in node.keywords:
+            if kw.arg not in _J015_BLOCK_KWARGS:
+                continue
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int) \
+                    and not isinstance(kw.value.value, bool):
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "J015",
+                    f"{kw.arg}={kw.value.value} is a literal block-size "
+                    f"override — it freezes one sweep's winner for every "
+                    f"device kind and shape; leave the blocks at their "
+                    f"defaults so the tune config cache decides per "
+                    f"device (python -m apex_tpu.tune), or pass a "
+                    f"measured variable"))
+    return findings
+
+
 # -- per-scope walker: J001, J004, J005, J006 ---------------------------------
 
 class _ScopeWalker:
@@ -1632,6 +1687,7 @@ def lint_source(src: str, path: str = "<string>",
     findings += _check_j011(tree, path)
     findings += _check_j013(tree, path)
     findings += _check_j014(tree, path)
+    findings += _check_j015(tree, path)
     _ScopeWalker(idx, path, driver, findings).lint_module(tree)
     kept = [f for f in findings if not waivers.waived(f)]
     kept += waivers.errors
