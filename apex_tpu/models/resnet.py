@@ -139,6 +139,12 @@ class ResNet(nn.Module):
     #: contract the blocks route their chains through it.  Overrides
     #: ``sync_bn``.
     norm_cls: Any = None
+    #: external conv factory (a module class or functools.partial over
+    #: one) mirroring ``norm_cls``, e.g. ``apex_tpu.ops.PallasConv``.
+    #: Must match the ``nn.Conv`` signature and parameter pytree so the
+    #: swap changes no checkpoint; shapes the factory cannot serve fall
+    #: back per site inside the factory itself.  None = ``nn.Conv``.
+    conv_cls: Any = None
     #: route ``bn -> relu -> (+residual)`` chains through the norm's
     #: fused epilogue: None = auto (fuse when the norm supports it),
     #: True = require it (ValueError if the norm can't), False = keep
@@ -157,8 +163,8 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
-                                 param_dtype=jnp.float32)
+        conv = functools.partial(self.conv_cls or nn.Conv, use_bias=False,
+                                 dtype=self.dtype, param_dtype=jnp.float32)
         if self.norm_cls is not None:
             norm = functools.partial(self.norm_cls,
                                      use_running_average=not train)

@@ -61,6 +61,13 @@ def _peel(component: str) -> str:
             component = m.group(1)
     if component in _TRANSFORM_BARE or component.startswith("custom_"):
         return ""
+    # conv backward machinery: XLA emits dgrad/wgrad under
+    # ``conv_general_dilated_transpose_lhs``/``..._rhs`` name-stack
+    # components (a prefix family like ``custom_*``).  Dropping them
+    # keeps a conv's dgrad/wgrad on the SAME ledger row as its forward
+    # region instead of splitting off and diluting per-region MFU.
+    if component.startswith("conv_general_dilated"):
+        return ""
     return component
 
 

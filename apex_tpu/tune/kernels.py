@@ -19,6 +19,14 @@ Per-spec notes:
 * **quantized_matmul** — ``block_m``/``block_n`` tiles; each output
   element is an int32 dot over the full K regardless of tile, so the
   oracle is bitwise too.
+* **conv2d** — ``block_m`` (im2col row tile) / ``block_n`` (output
+  channels); the tap loop is static and each tap contracts the FULL
+  input-channel axis in one dot, so partitioning never reorders an
+  output element's reduction: bitwise across configs.  The case runs
+  ``value_and_grad`` through the fused conv+bn_relu_residual custom
+  VJP so dgrad/wgrad are part of the measured clock.  kind="memory":
+  the r05 resnet ledger calls the stage1/stage2 conv regions
+  memory-bound, so small blocks visit first.
 
 Candidate priority (the ledger hook): memory-bound verdicts visit
 smaller blocks first (layout/pipelining candidates — more grid steps,
@@ -47,7 +55,7 @@ def _mod(name):
     return importlib.import_module("apex_tpu." + name)
 
 __all__ = ["FLASH_ATTENTION", "FUSED_LAYER_NORM", "BN_RELU_RESIDUAL",
-           "XENTROPY", "QUANTIZED_MATMUL"]
+           "XENTROPY", "QUANTIZED_MATMUL", "CONV2D"]
 
 #: generous flash-kernel VMEM estimate budget (operand + score blocks +
 #: scratch; the proven-on-chip 1024x1024 default must pass)
@@ -516,3 +524,111 @@ QUANTIZED_MATMUL = register(KernelSpec(
     example_shape={"m": 8192, "k": 4096, "n": 4096, "dtype": "bfloat16"},
     small_shape={"m": 64, "k": 128, "n": 128, "dtype": "float32"},
     regions=("quant", "qmm", "dense", "proj", "mlp")))
+
+
+# -- pallas conv2d (implicit GEMM + fused epilogue) ---------------------------
+
+def _conv_dims(shape: Mapping):
+    return (int(shape.get("batch", 32)), int(shape.get("h", 28)),
+            int(shape.get("w", 28)), int(shape.get("cin", 128)),
+            int(shape.get("cout", 128)), int(shape.get("kh", 3)),
+            int(shape.get("kw", 3)), int(shape.get("stride", 1)),
+            jnp.dtype(shape.get("dtype", "bfloat16")),
+            bool(shape.get("residual", True)))
+
+
+def _conv_candidates(shape: Mapping, bound: Optional[str]):
+    out = []
+    for bm in (128, 256, 512, 1024):
+        for bn in (128, 256, 512):
+            cfg = {"block_m": bm, "block_n": bn}
+            if _conv_constraint(shape, cfg):
+                out.append(cfg)
+    return out
+
+
+def _conv_constraint(shape: Mapping, cfg: Dict[str, int]) -> bool:
+    cv = _mod("ops.conv")
+    n, h, w, cin, cout, kh, kw, s, dtype, res = _conv_dims(shape)
+    padding = cv._norm_padding("SAME", h, w, kh, kw, s, s, 1, 1)
+    # want_preact=True: the training forward (epilogue + custom VJP)
+    # also streams the saved pre-activation block, the worst case.
+    return cv._fwd_fits(h, w, padding, cin, cout, kh, kw, s, s, 1, 1,
+                        int(cfg["block_m"]), int(cfg["block_n"]),
+                        dtype.itemsize, res, True)
+
+
+def _conv_case(shape: Mapping, interpret: bool) -> TuneCase:
+    import jax.random as jrandom
+    cv = _mod("ops.conv")
+    n, h, w, cin, cout, kh, kw, s, dtype, res = _conv_dims(shape)
+    x = (jrandom.normal(jrandom.PRNGKey(0), (n, h, w, cin), jnp.float32)
+         ).astype(dtype)
+    wt = (jrandom.normal(jrandom.PRNGKey(1), (kh, kw, cin, cout),
+                         jnp.float32) * 0.05).astype(dtype)
+    mean = jnp.zeros((cout,), jnp.float32)
+    invstd = jnp.ones((cout,), jnp.float32)
+    scale = jnp.ones((cout,), jnp.float32)
+    bias = jnp.zeros((cout,), jnp.float32)
+    oh, ow = -(-h // s), -(-w // s)
+    z = (jnp.ones((n, oh, ow, cout), jnp.float32).astype(dtype)
+         if res else None)
+    fns: Dict[tuple, object] = {}
+
+    def run(cfg):
+        key = (int(cfg["block_m"]), int(cfg["block_n"]))
+        f = fns.get(key)
+        if f is None:
+            bm, bn = key
+
+            def loss(x, wt, mean, invstd, scale, bias):
+                o = cv.conv2d(x, wt, stride=s, padding="SAME",
+                              mean=mean, invstd=invstd, scale=scale,
+                              bias=bias, z=z, relu=True, impl="pallas",
+                              interpret=interpret, block_m=bm,
+                              block_n=bn)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            f = fns[key] = jax.jit(jax.value_and_grad(
+                loss, argnums=(0, 1, 2, 3, 4, 5)))
+        return f(x, wt, mean, invstd, scale, bias)
+
+    return TuneCase(run=run)
+
+
+def _conv_bucket(shape: Mapping) -> str:
+    cv = _mod("ops.conv")
+    n, h, w, cin, cout, kh, kw, s, dtype, res = _conv_dims(shape)
+    oh, ow = -(-h // s), -(-w // s)
+    return cv.tune_bucket(n, oh, ow, cin, cout, kh, kw, s, s, 1, 1,
+                          dtype.itemsize, True, res)
+
+
+def _conv_version() -> int:
+    return _mod("ops.conv").TUNE_VERSION
+
+
+def _conv_effective(shape: Mapping, cfg: Dict[str, int]):
+    cv = _mod("ops.conv")
+    n, h, w, cin, cout, kh, kw, s, dtype, res = _conv_dims(shape)
+    oh, ow = -(-h // s), -(-w // s)
+    return (cv._pick_boh(oh, ow, int(cfg["block_m"])),
+            cv._pick_block(cout, int(cfg["block_n"]), 128))
+
+
+CONV2D = register(KernelSpec(
+    name="conv2d", version=_conv_version(),
+    params=("block_m", "block_n"), kind="memory", exact=True,
+    defaults=lambda shape: {"block_m": 512, "block_n": 256},
+    candidates=_conv_candidates, constraint=_conv_constraint,
+    build=_conv_case, bucket=_conv_bucket,
+    priority=lambda shape, cfg, bound: _area_priority(
+        cfg["block_m"] * cfg["block_n"], bound),
+    effective=_conv_effective,
+    example_shape={"batch": 32, "h": 28, "w": 28, "cin": 128,
+                   "cout": 128, "kh": 3, "kw": 3, "stride": 1,
+                   "dtype": "bfloat16", "residual": True},
+    small_shape={"batch": 2, "h": 8, "w": 8, "cin": 8, "cout": 16,
+                 "kh": 3, "kw": 3, "stride": 1, "dtype": "float32",
+                 "residual": True},
+    regions=("conv", "stage", "downsample")))

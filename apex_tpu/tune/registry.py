@@ -25,7 +25,7 @@ search one kernel's config space safely:
 * ``version`` — mirrors the kernel module's ``TUNE_VERSION``; bumping
   it invalidates every cached config for the kernel.
 
-The five builtin kernels register from :mod:`apex_tpu.tune.kernels`
+The six builtin kernels register from :mod:`apex_tpu.tune.kernels`
 (imported lazily by :func:`load_builtin` so the kernel modules — which
 themselves import ``tune.space``/``tune.dispatch`` for their dispatch
 consult — never see an import cycle).

@@ -472,13 +472,17 @@ def _make_resnet_step(opt_level, batch, image_size=224, num_classes=1000,
     if fused:
         # The shipping hot path (ISSUE 7): contrib GroupBN NHWC through
         # the ResNet norm-factory hook (bn->relu->(+residual) chains as
-        # ONE Pallas bn_relu_residual epilogue each) + the contrib fused
-        # softmax-xentropy — exactly what examples/imagenet runs with
-        # its default --fused-bn/--fused-loss flags.
+        # ONE Pallas bn_relu_residual epilogue each) + the NHWC
+        # implicit-GEMM Pallas convs (ISSUE 18, per-site XLA fallback
+        # for unservable shapes) + the contrib fused softmax-xentropy —
+        # exactly what examples/imagenet runs with its default
+        # --fused-bn/--fused-loss/--pallas-conv flags.
         import functools
         from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+        from apex_tpu.ops import PallasConv
         model = ResNet50(num_classes=num_classes, dtype=dtype,
-                         norm_cls=functools.partial(BatchNorm2d_NHWC))
+                         norm_cls=functools.partial(BatchNorm2d_NHWC),
+                         conv_cls=PallasConv)
     else:
         model = ResNet50(num_classes=num_classes, dtype=dtype)
     x = jnp.asarray(np.random.RandomState(0).rand(
@@ -1700,7 +1704,8 @@ def _bench_tune(on_tpu, ledger=None):
     """ISSUE 14 self-validation: the kernel autotuner end to end.
 
     For every registered kernel (flash_attention fwd+bwd,
-    fused_layer_norm, bn_relu_residual, xentropy, quantized_matmul):
+    fused_layer_norm, bn_relu_residual, xentropy, quantized_matmul,
+    conv2d fwd+bwd):
     search the config space on this backend (real device timing on
     chip; interpreter-mode probe on CPU so the whole machinery still
     runs in CI), candidate priority driven by the freshest resnet

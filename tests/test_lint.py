@@ -2,7 +2,7 @@
 
 Two layers, mirroring the linter's contract (docs/jaxlint.md):
 
-1. fixture self-tests — for every rule J001-J015 a known-bad snippet
+1. fixture self-tests — for every rule J001-J016 a known-bad snippet
    must flag and the same snippet with an inline waiver (or the real
    fix) must pass, so a rule that silently stops firing breaks CI
    before it stops protecting the codebase;
@@ -1438,5 +1438,69 @@ def test_j015_is_advisory_and_waivable():
 
     def reference_probe(q, k, v):
         return flash_attention(q, k, v, causal=True, block_q=1024, block_k=1024)  # jaxlint: disable=J015 -- documented reference path: pins the r4 sweep winner as the A/B baseline
+    """
+    assert _codes(waived) == []
+
+
+# -- J016: NCHW convolution layouts (ISSUE 18) --------------------------------
+
+def test_j016_flags_missing_dimension_numbers():
+    bad = """
+    import jax
+
+    def model(x, w):
+        # lax's DEFAULT dimension_numbers IS ('NCHW','OIHW','NCHW')
+        return jax.lax.conv_general_dilated(x, w, (1, 1), "SAME")
+    """
+    assert _codes(bad) == ["J016"]
+
+
+def test_j016_flags_nchw_literal_and_lax_conv():
+    bad = """
+    import jax
+    from jax import lax
+
+    def model(x, w):
+        a = lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        b = jax.lax.conv(x, w, (1, 1), "SAME")
+        c = lax.conv_with_general_padding(x, w, (1, 1), [(0, 0), (0, 0)],
+                                          None, None)
+        return a, b, c
+    """
+    findings = lint_source(textwrap.dedent(bad), "apex_tpu/fixture.py")
+    assert [f.rule for f in findings] == ["J016"] * 3
+
+
+def test_j016_nhwc_and_non_lax_conv_pass():
+    ok = """
+    import jax
+    from jax import lax
+
+    def model(self, x, w, dn):
+        # explicit NHWC is the sanctioned spelling
+        a = lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # a variable / ConvDimensionNumbers spec is not inspected
+        b = jax.lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                         dimension_numbers=dn)
+        # the bare leaf 'conv' (module factories, self.conv) never fires
+        c = self.conv(x)
+        return a, b, c
+    """
+    assert _codes(ok) == []
+
+
+def test_j016_is_advisory_and_waivable():
+    from tools.jaxlint.linter import Finding
+
+    assert Finding("p", 1, 0, "J016", "m").advisory
+    waived = """
+    import jax
+
+    def nchw_ab_probe(x, w):
+        return jax.lax.conv(x, w, (1, 1), "SAME")  # jaxlint: disable=J016 -- deliberate NCHW side of the layout A/B benchmark
     """
     assert _codes(waived) == []

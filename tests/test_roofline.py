@@ -147,6 +147,16 @@ def test_region_path_helper():
     for name in ("branch2a", "body_net", "scanner", "jitter", "condhead"):
         assert capture.region_path(f"{name}/mm") == name
     assert capture.region_path("custom_vjp_call") == "<unattributed>"
+    # conv backward machinery peels like custom_*: dgrad/wgrad land on
+    # the forward conv's ledger row instead of splitting off (ISSUE 18)
+    assert capture.region_path(
+        "transpose(jvp(stage1))/conv_general_dilated_transpose_lhs"
+    ) == "stage1"
+    assert capture.region_path(
+        "stage1/conv_general_dilated_transpose_rhs", depth=2) == "stage1"
+    assert capture.region_path(
+        "conv_general_dilated_transpose_lhs/mm") == "mm"
+    assert capture.region_path("conv_general_dilated") == "<unattributed>"
 
 
 def test_harvest_never_retraces_the_training_step():

@@ -181,11 +181,16 @@ RULES: Dict[str, str] = {
             "dispatching through the tune registry/config cache — the "
             "literal freezes one device's sweep winner for every "
             "device kind (python -m apex_tpu.tune; advisory)",
+    "J016": "NCHW convolution layout: lax.conv_general_dilated with "
+            "missing or NC*-leading dimension_numbers, or the "
+            "always-NCHW lax.conv/lax.conv_with_general_padding "
+            "wrappers — TPU-hostile (the feature axis belongs on the "
+            "128 lanes; use ('NHWC','HWIO','NHWC'); advisory)",
 }
 
 #: Rules reported as advice, not errors: the CLI exits 0 when only
 #: advisory findings remain, and ``Finding.advisory`` marks them.
-ADVISORY_RULES: Set[str] = {"J011", "J013", "J014", "J015"}
+ADVISORY_RULES: Set[str] = {"J011", "J013", "J014", "J015", "J016"}
 
 # Functions whose *contract* is the host boundary: serialization must
 # materialize host values, so J001 does not fire inside them.  Everything
@@ -1068,7 +1073,7 @@ def _check_j014(tree: ast.Module, path: str) -> List[Finding]:
 #: site — listing it would document a parameter that does not exist)
 _J015_KERNEL_CALLS = {"flash_attention", "bn_relu_residual",
                       "fused_layer_norm", "fused_layer_norm_affine",
-                      "quantized_matmul"}
+                      "quantized_matmul", "conv2d"}
 #: the tuned block-size parameters across the kernel family
 _J015_BLOCK_KWARGS = {"block_q", "block_k", "block_m", "block_n",
                       "row_block"}
@@ -1096,6 +1101,69 @@ def _check_j015(tree: ast.Module, path: str) -> List[Finding]:
                     f"defaults so the tune config cache decides per "
                     f"device (python -m apex_tpu.tune), or pass a "
                     f"measured variable"))
+    return findings
+
+
+# -- J016: NCHW convolution layouts -------------------------------------------
+
+#: always-NCHW lax convenience wrappers (no dimension_numbers knob);
+#: matched by the FULL dotted suffix ``lax.<name>`` — the bare leaf
+#: ``conv`` is far too common (``self.conv(...)`` factories) to match
+_J016_LAX_NCHW_CALLS = {"conv", "conv_with_general_padding"}
+
+
+def _j016_spec_is_nchw(value: ast.expr) -> Optional[bool]:
+    """True/False when ``dimension_numbers=`` is a literal spec we can
+    read (tuple/list of strings: NC* -> True, else False); None when it
+    is a variable / ConvDimensionNumbers expression (not inspected)."""
+    if isinstance(value, (ast.Tuple, ast.List)) and value.elts:
+        first = value.elts[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value.upper().startswith("NC")
+    return None
+
+
+def _check_j016(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name:
+            continue
+        parts = name.split(".")
+        leaf = parts[-1]
+        if leaf == "conv_general_dilated":
+            dims = None
+            for kw in node.keywords:
+                if kw.arg == "dimension_numbers":
+                    dims = kw.value
+            if dims is None and len(node.args) >= 6:
+                dims = node.args[5]
+            if dims is None:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "J016",
+                    "conv_general_dilated without dimension_numbers= — "
+                    "the lax default IS NCHW ('NCHW','OIHW','NCHW'), a "
+                    "TPU-hostile layout that transposes around every "
+                    "conv; spell ('NHWC','HWIO','NHWC') explicitly"))
+            elif _j016_spec_is_nchw(dims):
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "J016",
+                    "NCHW dimension_numbers at a conv call site — TPUs "
+                    "tile the feature axis onto the 128 lanes, so NCHW "
+                    "pays a transpose either side of every conv and "
+                    "walls off the NHWC Pallas conv path; use "
+                    "('NHWC','HWIO','NHWC')"))
+        elif (leaf in _J016_LAX_NCHW_CALLS and len(parts) >= 2
+              and parts[-2] == "lax"):
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "J016",
+                f"lax.{leaf} is the always-NCHW convenience wrapper — "
+                f"it has no layout knob and lands the TPU-hostile "
+                f"('NCHW','OIHW','NCHW') spec; call "
+                f"conv_general_dilated with ('NHWC','HWIO','NHWC') or "
+                f"use flax.linen.Conv / apex_tpu.ops.PallasConv"))
     return findings
 
 
@@ -1688,6 +1756,7 @@ def lint_source(src: str, path: str = "<string>",
     findings += _check_j013(tree, path)
     findings += _check_j014(tree, path)
     findings += _check_j015(tree, path)
+    findings += _check_j016(tree, path)
     _ScopeWalker(idx, path, driver, findings).lint_module(tree)
     kept = [f for f in findings if not waivers.waived(f)]
     kept += waivers.errors
