@@ -11,7 +11,8 @@ from .attention import (blockwise_attention, mha_attention,  # noqa: F401
                         dot_product_attention)
 from .flash_attention import flash_attention  # noqa: F401
 from .conv import (conv2d, conv2d_ref, PallasConv,  # noqa: F401
-                   conv_dispatch_stats, reset_conv_dispatch_stats)
+                   conv_dispatch_stats, reset_conv_dispatch_stats,
+                   publish_conv_counters)
 from . import losses  # noqa: F401
 from .losses import (binary_cross_entropy,  # noqa: F401
                      binary_cross_entropy_with_logits)

@@ -74,7 +74,8 @@ from ..normalization.fused_bn_act import bn_act_epilogue_ref
 from ..normalization.fused_layer_norm import _use_pallas
 
 __all__ = ["conv2d", "conv2d_ref", "PallasConv", "conv_dispatch_stats",
-           "reset_conv_dispatch_stats", "tune_bucket"]
+           "reset_conv_dispatch_stats", "publish_conv_counters",
+           "tune_bucket"]
 
 #: config-cache version of this kernel's blocking scheme (ISSUE 14).
 TUNE_VERSION = 1
@@ -638,6 +639,33 @@ def conv_dispatch_stats() -> Dict[str, Any]:
 def reset_conv_dispatch_stats() -> None:
     _DISPATCH_COUNTS["pallas"] = _DISPATCH_COUNTS["fallback"] = 0
     _FALLBACK_REASONS.clear()
+
+
+def publish_conv_counters(registry) -> Dict[str, int]:
+    """Export the dispatch counters into a telemetry
+    :class:`~apex_tpu.telemetry.MetricsRegistry` as monotonic
+    ``conv_pallas_sites`` / ``conv_fallback_sites`` /
+    ``conv_fallback_<reason>`` counters (ISSUE 20 satellite: the dark
+    counts, on the Prometheus surface instead of only a stats dict).
+
+    Delta-published — each call bumps every counter by how much its
+    module-global count grew since the LAST publish, so periodic calls
+    (an exporter hook, an example's exit path) stay monotonic even
+    though :func:`reset_conv_dispatch_stats` may never run.  Returns
+    the raw stats dict for the caller's own print line."""
+    stats = conv_dispatch_stats()
+    flat: Dict[str, int] = {
+        "conv_pallas_sites": stats["pallas_sites"],
+        "conv_fallback_sites": stats["fallback_sites"],
+    }
+    for reason, n in stats["fallback_reasons"].items():
+        flat[f"conv_fallback_{reason}"] = int(n)
+    for name, total in flat.items():
+        c = registry.counter(name)
+        delta = total - (c.value or 0)
+        if delta > 0:
+            c.inc(delta)
+    return stats
 
 
 def _site_reason(x_shape, w_shape, padding, stride, dilation,

@@ -61,10 +61,11 @@ import re as _re
 
 _HIGHER_RE = _re.compile(
     r"per_s(ec)?(_|$|\.)|img_s|it_s(_|$)|tok_s|tflops|mfu|speedup|gb_s"
-    r"|(^|_)bw(_|$)|coverage|img/s")
+    r"|(^|_)bw(_|$)|coverage|img/s|goodput")
 _LOWER_RE = _re.compile(
     r"_ms(_|$|\.)|(^|\.)ms_|(^|_)time|stall|gap|retrace|skips|alert"
-    r"|overhead|wall|compile|(^|_)dur(_|$)|wait|spread|_s$|_s\.")
+    r"|overhead|wall|compile|(^|_)dur(_|$)|wait|spread|_s$|_s\."
+    r"|burn_(short|long|rate)")
 # keys that are identifiers/config, never compared even though numeric
 _SKIP_FRAGMENTS = ("schema_version", "batch", "seq", "iters", "n_params",
                    "n_tensors", "n_leaves", "n_buckets", "image_size",

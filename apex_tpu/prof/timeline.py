@@ -50,7 +50,8 @@ __all__ = ["SCHEMA_VERSION", "load_events", "analyze", "format_report",
 #: inputs whose major it does not understand (see
 #: :func:`check_schema_version`) instead of silently comparing
 #: incompatible numbers.
-SCHEMA_VERSION = "1.1"      # 1.1: + memory section (ISSUE 10)
+SCHEMA_VERSION = "1.2"      # 1.1: + memory section (ISSUE 10)
+#                             1.2: + requests section (ISSUE 20)
 
 
 def check_schema_version(obj: Dict[str, Any], where: str = "input") -> None:
@@ -272,6 +273,16 @@ def analyze(events: List[dict]) -> Dict[str, Any]:
         "by_axis": _axis_totals(colls, steps),
     }
 
+    # -- serving requests (ISSUE 20) ----------------------------------------
+    # Present only when the stream came from the serving engine (has
+    # `done` serving events): TTFT/TPOT/e2e/queue-wait percentiles over
+    # EVERY finished request plus the batch-size-vs-TPOT join — the
+    # schema-1.2 addition `prof.requests` computes in full detail.
+    from .requests import request_stats
+    req = request_stats(events)
+    if req is not None:
+        out["requests"] = req
+
     if summary is not None:
         out["summary"] = {k: v for k, v in summary.items()
                           if k not in ("t", "kind")}
@@ -369,6 +380,14 @@ def format_report(a: Dict[str, Any]) -> str:
         lines.append(f"health: {al['total']} watchdog alert(s) ({rules})"
                      + (f" at steps {al['steps'][:8]}"
                         if al.get("steps") else ""))
+    rq = a.get("requests") or {}
+    if rq:
+        t, tp = rq.get("ttft") or {}, rq.get("tpot") or {}
+        lines.append(f"serving: {rq['n_requests']} requests, "
+                     f"{rq['tokens_out']} tokens out  "
+                     f"ttft p50/p99 {t.get('p50_ms')}/{t.get('p99_ms')} ms"
+                     f"  tpot p50/p99 {tp.get('p50_ms')}/{tp.get('p99_ms')}"
+                     f" ms  (waterfalls: python -m apex_tpu.prof.requests)")
     co = a.get("collectives") or {}
     if co.get("by_op"):
         lines.append(f"collectives: "

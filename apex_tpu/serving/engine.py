@@ -35,7 +35,15 @@ them pointed at requests instead of batches:
   ``serving_queue_depth`` / ``serving_active_seqs`` /
   ``serving_kv_page_occupancy_pct`` / ``serving_tokens_per_s`` gauges
   through the existing recorder into the Prometheus export; the
-  ``serving_queue_stall`` watchdog rule folds the admit events.
+  ``serving_queue_stall`` watchdog rule folds the admit events.  With
+  a tracer attached (``telemetry.start(trace_sample_n=N)``, ISSUE 20)
+  every Nth request additionally emits a ``span`` tree
+  (queue/prefill/per-step decode/hotswap under a ``request`` root),
+  and every finished request records TTFT / TPOT / e2e into the
+  ``serving_ttft_s`` / ``serving_tpot_s`` / ``serving_e2e_s``
+  histograms and its ``done`` event — the inputs of the SLO engine
+  (:mod:`apex_tpu.telemetry.slo`) and the offline request analyzer
+  (``python -m apex_tpu.prof.requests``).
 
 Decoding is greedy (``argmax``) — deliberately: bitwise-reproducible
 outputs are what make the hot-swap acceptance gate (post-swap output ==
@@ -118,7 +126,10 @@ class Completion:
 
 
 class _Active(NamedTuple):
-    """One admitted sequence (a batch slot)."""
+    """One admitted sequence (a batch slot).  ``trace``/``root`` are
+    the request's trace id and root span id when it was sampled by the
+    recorder's tracer (ISSUE 20), else None — the untraced hot path
+    carries two Nones and emits nothing."""
     request: Request
     completion: Completion
     bucket: int
@@ -126,6 +137,8 @@ class _Active(NamedTuple):
     t_submit: float
     t_admit: float
     t_prefill_done: float
+    trace: Optional[str] = None
+    root: Optional[str] = None
 
 
 class ServingEngine:
@@ -202,6 +215,10 @@ class ServingEngine:
                           model, cache_dtype)}
         self._telemetry = telemetry
         self._t_rate = None                    # tokens/s gauge anchor
+        #: idle horizon for the tokens/s gauge when no exporter is
+        #: attached (with one, its ``every_s`` is the horizon): no
+        #: decode dispatch within this window zeroes the rate gauge.
+        self.rate_idle_s = 5.0
         self.watcher: Optional[WeightWatcher] = None
         if watch_dir is not None:
             # watch_from_step: the checkpoint step `params` came from
@@ -226,6 +243,10 @@ class ServingEngine:
         if rec is not None:
             rec.event("serving", phase=phase, **fields)
 
+    def _tracer(self):
+        rec = self._rec()
+        return getattr(rec, "tracer", None) if rec is not None else None
+
     def _gauges(self) -> None:
         rec = self._rec()
         if rec is None:
@@ -239,6 +260,27 @@ class ServingEngine:
             self.pages.occupancy_pct)
         rec.metrics.gauge("serving_kv_bytes_per_token").set(
             self.stats["kv_bytes_per_token"])
+        # tokens/s idle decay (ISSUE 20 satellite): the rate gauge is
+        # computed from inter-dispatch gaps, so with no decode landing
+        # it would keep exporting the LAST burst's rate forever — zero
+        # it once nothing dispatched within the export interval and
+        # drop the anchor, so the next burst's first sample doesn't
+        # divide by the idle gap either.
+        if self._t_rate is not None:
+            exp = getattr(rec, "exporter", None)
+            idle_s = (exp.every_s if exp is not None
+                      else self.rate_idle_s)
+            if time.perf_counter() - self._t_rate > idle_s:
+                rec.metrics.gauge("serving_tokens_per_s").set(0.0)
+                self._t_rate = None
+        # dark counters (ISSUE 20 satellite): stats that only lived in
+        # the exit dict become scrapeable monotonic counters — exported
+        # by delta so the registry stays the single Prometheus source.
+        for key in ("aot_misses", "rejected"):
+            c = rec.metrics.counter(f"serving_{key}")
+            delta = self.stats[key] - c.value
+            if delta > 0:
+                c.inc(delta)
         # run-info label, not a sample: capacity dashboards slice
         # tokens/sec and occupancy by the KV storage dtype (ISSUE 13)
         rec.run_info["kv_cache_dtype"] = self.kv_cache_dtype
@@ -372,6 +414,12 @@ class ServingEngine:
                              f"{max_new_tokens}")
         req = Request(prompt, int(max_new_tokens), stop_token)
         comp = Completion()
+        # Trace sampling (ISSUE 20): one counter read per request; a
+        # sampled request gets its trace id + root span id HERE so
+        # every later phase (even across the queue) parents to it.
+        tracer = self._tracer()
+        trace = tracer.sample() if tracer is not None else None
+        root = tracer.next_span_id() if trace is not None else None
         with self._qcond:
             # closed-check under the SAME lock close() drains under — a
             # request appended after the drain would strand its caller
@@ -385,11 +433,16 @@ class ServingEngine:
                     raise TimeoutError("request queue stayed full")
                 if self._closed:
                     raise RuntimeError("ServingEngine is closed")
-            self._queue.append((req, comp, time.perf_counter()))
+            self._queue.append((req, comp, time.perf_counter(),
+                                trace, root))
             depth = len(self._queue)
         self.stats["submitted"] += 1
+        fields = {}
+        if trace is not None:
+            fields["trace"] = trace
         self._event("submit", prompt_len=int(prompt.size),
-                    max_new=int(max_new_tokens), queue_depth=depth)
+                    max_new=int(max_new_tokens), queue_depth=depth,
+                    **fields)
         rec = self._rec()
         if rec is not None:
             rec.metrics.gauge("serving_queue_depth").set(depth)
@@ -452,6 +505,17 @@ class ServingEngine:
         self.stats["hotswaps"] += 1
         self._event("hotswap", step=step,
                     in_flight=sum(1 for s in self._slots if s is not None))
+        tracer = self._tracer()
+        if tracer is not None:
+            # the swap joins every in-flight traced request's tree: an
+            # instant child span per participant, so a waterfall shows
+            # exactly which decode gap the adoption (and the watcher's
+            # CheckpointManager restore, the `stage` event preceding
+            # it) landed in — the swap's latency impact is attributable
+            for act in self._slots:
+                if act is not None and act.trace is not None:
+                    tracer.emit("hotswap", act.trace, parent=act.root,
+                                step=step)
         return True
 
     def _admit(self) -> bool:
@@ -464,7 +528,7 @@ class ServingEngine:
             with self._qcond:
                 if not self._queue:
                     break
-                req, comp, t_submit = self._queue[0]
+                req, comp, t_submit, trace, root = self._queue[0]
                 bucket = self._bucket_for(req.prompt.size
                                           + req.max_new_tokens)
                 if bucket is None:
@@ -490,15 +554,22 @@ class ServingEngine:
                           f"(max {self.buckets[-1]})"))
                 continue
             self._prefill_into(free_slot, req, comp, t_submit, bucket,
-                               pages)
+                               pages, trace, root)
             admitted = True
         return admitted
 
     def _prefill_into(self, slot: int, req: Request, comp: Completion,
-                      t_submit: float, bucket: int,
-                      pages: List[int]) -> None:
+                      t_submit: float, bucket: int, pages: List[int],
+                      trace: Optional[str] = None,
+                      root: Optional[str] = None) -> None:
         t_admit = time.perf_counter()
         queue_wait = t_admit - t_submit
+        tracer = self._tracer() if trace is not None else None
+        if tracer is not None:
+            # emitted AT admission so the span's end (`t`) is now and
+            # its start lands back at submit — the waterfall's first bar
+            tracer.emit("queue", trace, parent=root, dur=queue_wait,
+                        slot=slot, bucket=bucket)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :req.prompt.size] = req.prompt
         args = (self.params, self.pool_k, self.pool_v,
@@ -515,6 +586,10 @@ class ServingEngine:
                     prompt_len=int(req.prompt.size),
                     queue_wait=round(queue_wait, 6),
                     prefill_dur=round(t_done - t_admit, 6))
+        if tracer is not None:
+            tracer.emit("prefill", trace, parent=root,
+                        dur=t_done - t_admit, slot=slot, bucket=bucket,
+                        prompt_len=int(req.prompt.size))
         rec = self._rec()
         if rec is not None:
             rec.metrics.histogram("serving_queue_wait_s").observe(
@@ -522,7 +597,8 @@ class ServingEngine:
             rec.metrics.histogram("serving_prefill_s").observe(
                 t_done - t_admit)
         self._slots[slot] = _Active(req, comp, bucket, pages,
-                                    t_submit, t_admit, t_done)
+                                    t_submit, t_admit, t_done,
+                                    trace, root)
         self._pos[slot] = req.prompt.size
         self._tok[slot] = first
         self._gen[slot] = [first]
@@ -559,6 +635,11 @@ class ServingEngine:
         self.stats["decode_steps"] += 1
         n_tok = len(live)
         self.stats["tokens_out"] += n_tok
+        # capture traced participants BEFORE the finish loop clears
+        # their slots — the step's span belongs to every traced request
+        # that decoded in it, finished or not
+        traced = [(i, self._slots[i]) for i in live
+                  if self._slots[i].trace is not None]
         for i in live:
             self._pos[i] += 1
             tok = int(toks[i])
@@ -571,6 +652,17 @@ class ServingEngine:
         rec = self._rec()
         self._event("decode", active=n_tok, bucket=bucket,
                     dur=round(dur, 6))
+        if traced:
+            tracer = self._tracer()
+            if tracer is not None:
+                for i, act in traced:
+                    # the ONE batched dispatch, as a child span per
+                    # traced participant: slot + batch size make the
+                    # continuous-batching interference visible per
+                    # request (the batch-size/TPOT join reads these)
+                    tracer.emit("decode_step", act.trace,
+                                parent=act.root, dur=dur, slot=i,
+                                bucket=bucket, batch_size=n_tok)
         if rec is not None:
             rec.metrics.histogram("serving_decode_step_s").observe(dur)
             now = time.perf_counter()
@@ -587,11 +679,21 @@ class ServingEngine:
             gen = gen[:gen.index(req.stop_token) + 1]
         t_done = time.perf_counter()
         decode_s = t_done - act.t_prefill_done
+        # The headline LLM serving metrics (ISSUE 20): TTFT is
+        # submit -> first token (prefill already materializes it on the
+        # host, so no new sync), TPOT the mean inter-token time over
+        # the remaining tokens, e2e the whole journey.
+        ttft_s = act.t_prefill_done - act.t_submit
+        tpot_s = (decode_s / (len(gen) - 1)
+                  if decode_s > 0 and len(gen) > 1 else None)
+        e2e_s = t_done - act.t_submit
         timings = {
             "queue_wait_s": round(act.t_admit - act.t_submit, 6),
             "prefill_s": round(act.t_prefill_done - act.t_admit, 6),
             "decode_s": round(decode_s, 6),
-            "total_s": round(t_done - act.t_submit, 6),
+            "total_s": round(e2e_s, 6),
+            "ttft_s": round(ttft_s, 6),
+            "tpot_s": round(tpot_s, 6) if tpot_s is not None else None,
             "tok_per_s": (round((len(gen) - 1) / decode_s, 2)
                           if decode_s > 0 and len(gen) > 1 else None),
         }
@@ -601,8 +703,26 @@ class ServingEngine:
         self._tok[slot] = 0
         self._gen[slot] = []
         self.stats["completed"] += 1
+        rec = self._rec()
+        if rec is not None:
+            rec.metrics.histogram("serving_ttft_s").observe(ttft_s)
+            if tpot_s is not None:
+                rec.metrics.histogram("serving_tpot_s").observe(tpot_s)
+            rec.metrics.histogram("serving_e2e_s").observe(e2e_s)
+        fields = {}
+        if act.trace is not None:
+            fields["trace"] = act.trace
         self._event("done", slot=slot, bucket=act.bucket,
-                    n_tokens=len(gen), **timings)
+                    n_tokens=len(gen), **fields, **timings)
+        if act.trace is not None:
+            tracer = self._tracer()
+            if tracer is not None:
+                # the root: emitted LAST with the span id allocated at
+                # submit, so every child already points at it
+                tracer.emit("request", act.trace, span=act.root,
+                            dur=e2e_s, slot=slot, bucket=act.bucket,
+                            n_tokens=len(gen),
+                            ttft_s=round(ttft_s, 6))
         act.completion._set(ServedResult(
             tokens=np.asarray(gen, np.int32), timings=timings,
             bucket=act.bucket))
@@ -643,7 +763,7 @@ class ServingEngine:
             self._qcond.notify_all()
         closed = ServedResult(tokens=np.zeros((0,), np.int32),
                               timings={}, error="engine closed")
-        for _req, comp, _t in abandoned:
+        for _req, comp, _t, _trace, _root in abandoned:
             comp._set(closed)
         # admitted-but-unfinished sequences: the serve thread is down,
         # so no further decode step will ever finish them

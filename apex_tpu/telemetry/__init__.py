@@ -33,9 +33,12 @@ from .events import (Recorder, get_recorder, set_recorder,  # noqa: F401
 from .export import PrometheusExporter, attach_exporter     # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,            # noqa: F401
                       MetricsRegistry, Rolling)
+from .slo import SLOEngine, SLOSpec, parse_slo              # noqa: F401
+from .tracing import Tracer                                 # noqa: F401
 from .watchdog import Watchdog                              # noqa: F401
 
 __all__ = ["Recorder", "get_recorder", "set_recorder", "start",
            "start_from_env", "to_chrome_trace", "expand_stream_paths",
            "PrometheusExporter", "attach_exporter", "Counter", "Gauge",
-           "Histogram", "MetricsRegistry", "Rolling", "Watchdog"]
+           "Histogram", "MetricsRegistry", "Rolling", "Watchdog",
+           "Tracer", "SLOEngine", "SLOSpec", "parse_slo"]

@@ -91,6 +91,8 @@ def start(path: Optional[str] = None, watchdog: Optional[bool] = None,
           export_textfile: Optional[str] = None,
           export_port: Optional[int] = None,
           export_every_s: float = 5.0,
+          trace_sample_n: Optional[int] = None,
+          slo: Optional[str] = None,
           process_index: Optional[int] = None,
           process_count: Optional[int] = None,
           **meta) -> "Recorder":
@@ -130,7 +132,16 @@ def start(path: Optional[str] = None, watchdog: Optional[bool] = None,
     counters/gauges/histograms plus watchdog health rendered to
     text-exposition format every ``export_every_s`` seconds on the
     threads that already emit events (zero extra host syncs) and/or
-    served from a stdlib http endpoint."""
+    served from a stdlib http endpoint.
+
+    ``trace_sample_n`` attaches the request tracer
+    (:mod:`apex_tpu.telemetry.tracing`, ISSUE 20): every Nth sampled
+    unit (serving request) emits a ``span`` tree into the same stream;
+    defaults from ``APEX_TPU_TRACE_SAMPLE`` (unset/0 -> no tracing).
+    ``slo`` attaches the SLO engine (:mod:`apex_tpu.telemetry.slo`) on
+    a spec string like ``"ttft_p99<200ms,tpot_p99<30ms"`` (env
+    ``APEX_TPU_SLO``): goodput/burn-rate gauges fold online and the
+    watchdog's ``slo_burn``/``slo_exhausted`` rules alert on them."""
     if path is None:
         path = os.environ.get("APEX_TPU_TELEMETRY") or None
         if path is None:
@@ -146,6 +157,11 @@ def start(path: Optional[str] = None, watchdog: Optional[bool] = None,
     if export_port is None:
         raw_port = os.environ.get("APEX_TPU_METRICS_PORT")
         export_port = int(raw_port) if raw_port else None
+    if trace_sample_n is None:
+        from .tracing import sample_n_from_env
+        trace_sample_n = sample_n_from_env()
+    if slo is None:
+        slo = (os.environ.get("APEX_TPU_SLO") or "").strip() or None
     rec = Recorder(path, meta=meta or None, run_id=run_id,
                    max_bytes=max_bytes, process_index=process_index,
                    process_count=process_count)
@@ -156,6 +172,12 @@ def start(path: Optional[str] = None, watchdog: Optional[bool] = None,
         from .export import attach_exporter
         attach_exporter(rec, textfile=export_textfile, port=export_port,
                         every_s=export_every_s)
+    if trace_sample_n and trace_sample_n > 0:
+        from .tracing import attach as attach_tracer
+        attach_tracer(rec, sample_n=trace_sample_n)
+    if slo is not None:
+        from .slo import attach as attach_slo
+        attach_slo(rec, slo)
     set_recorder(rec)
     return rec
 
@@ -274,6 +296,10 @@ class Recorder:
         self._watchdog = None
         #: optional live metrics exporter (export.attach_exporter)
         self._exporter = None
+        #: optional request tracer (tracing.attach — ISSUE 20)
+        self._tracer = None
+        #: optional SLO fold (slo.attach — ISSUE 20)
+        self._slo = None
         self.event("run", **self._run_fields())
 
     def _run_fields(self) -> Dict[str, Any]:
@@ -318,6 +344,13 @@ class Recorder:
         wd = self._watchdog
         if wd is not None and kind != "alert":
             wd.observe(rec)
+        # SLO fold (ISSUE 20): same discipline — done events fold into
+        # goodput/burn state here; the `slo` events an evaluation emits
+        # re-enter event() (and ARE watchdog-folded, so slo_burn /
+        # slo_exhausted can alert) but are not re-folded here.
+        slo = self._slo
+        if slo is not None and kind not in ("alert", "slo"):
+            slo.observe(rec)
         # Live-export tick (ISSUE 10): same zero-extra-thread discipline
         # — the exporter piggybacks on whichever thread wrote the event
         # and renders only when its interval has elapsed.
@@ -374,6 +407,30 @@ class Recorder:
     def exporter(self):
         """The attached exporter, or None."""
         return self._exporter
+
+    def attach_tracer(self, tracer) -> None:
+        """Install a request tracer
+        (:class:`apex_tpu.telemetry.tracing.Tracer`): instrumented
+        subsystems (the serving engine) discover it here and emit
+        sampled ``span`` trees through this recorder."""
+        self._tracer = tracer
+
+    @property
+    def tracer(self):
+        """The attached tracer, or None (tracing off)."""
+        return self._tracer
+
+    def attach_slo(self, slo) -> None:
+        """Install an SLO fold
+        (:class:`apex_tpu.telemetry.slo.SLOEngine`): every ``serving``
+        ``done`` event written from now on updates its goodput/burn
+        windows, and the final ``summary`` event carries its verdict."""
+        self._slo = slo
+
+    @property
+    def slo(self):
+        """The attached SLO engine, or None."""
+        return self._slo
 
     @contextlib.contextmanager
     def span(self, kind: str, **fields):
@@ -478,6 +535,8 @@ class Recorder:
         summary_fields = {"metrics": self.metrics.snapshot()}
         if self._watchdog is not None:
             summary_fields["health"] = self._watchdog.health()
+        if self._slo is not None and self._slo.last is not None:
+            summary_fields["slo"] = dict(self._slo.last)
         self.event("summary", events=dict(self._counts), **summary_fields)
         if self._exporter is not None:
             # final render BEFORE the stream closes: the scrape target
@@ -513,6 +572,7 @@ _CHROME_TIDS = {
     "loader_wait": (3, "consumer wait (loader)"),
     "stage": (4, "device staging (H2D)"),
     "opt_step": (5, "optimizer step"),
+    "span": (10, "request spans"),
 }
 _CHROME_INSTANT = {"scale": 6, "retrace": 7, "collective": 8, "marker": 9}
 _CHROME_INSTANT_ROW = {6: "loss scale", 7: "retrace", 8: "collectives",
@@ -615,6 +675,11 @@ def chrome_events(events, *, pid: int = 0, host: Optional[str] = None,
                 name = f"window@{e.get('step')}"
             elif kind == "metrics":
                 name = f"fetch@{e.get('step')}"
+            elif kind == "span":
+                # nested complete slices on one row: queue/prefill/
+                # decode sit inside their request span time-wise, so
+                # Perfetto renders the waterfall as a flame
+                name = f"{e.get('name', 'span')}@{e.get('trace')}"
             out.append({"ph": "X", "pid": pid, "tid": tid, "name": name,
                         "ts": t_us - dur_us, "dur": max(dur_us, 1.0),
                         "args": args})

@@ -143,6 +143,22 @@ def render(recorder) -> str:
                 lines.append(_line(metric, h[key], {"quantile": q}))
         lines.append(_line(f"{metric}_sum", h.get("sum", 0.0)))
         lines.append(_line(f"{metric}_count", h.get("count", 0)))
+        # true cumulative histogram (ISSUE 20 satellite): exact
+        # fixed-bound bucket counts as a SEPARATE `_hist` family —
+        # Prometheus forbids mixing summary and histogram series under
+        # one name, and the summary family above is the stable surface
+        # existing dashboards scrape.  `rate()`/`histogram_quantile()`
+        # work on this one.
+        buckets = h.get("buckets")
+        if isinstance(buckets, dict) and buckets.get("le"):
+            hist = f"{metric}_hist"
+            lines.append(f"# TYPE {hist} histogram")
+            for le, c in zip(buckets["le"], buckets.get("counts") or []):
+                lines.append(_line(f"{hist}_bucket", c, {"le": _num(le)}))
+            lines.append(_line(f"{hist}_bucket", h.get("count", 0),
+                               {"le": "+Inf"}))
+            lines.append(_line(f"{hist}_sum", h.get("sum", 0.0)))
+            lines.append(_line(f"{hist}_count", h.get("count", 0)))
     wd = recorder.watchdog
     if wd is not None:
         health = wd.health()

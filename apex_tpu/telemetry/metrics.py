@@ -25,12 +25,31 @@ lookup plus a no-op call, so instrumented library code never needs an
 
 from __future__ import annotations
 
+import bisect
 import random
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Rolling",
-           "nearest_rank_percentiles"]
+           "nearest_rank_percentiles", "LATENCY_BUCKETS_S",
+           "default_buckets"]
+
+#: Fixed cumulative-histogram bounds (seconds) every ``*_s`` latency
+#: histogram gets by default (ISSUE 20 satellite): log-ish spacing from
+#: 1 ms to 60 s.  FIXED per histogram for the whole run — Prometheus
+#: ``_bucket{le=...}`` series are only rate()-able when the bounds
+#: never move under the scraper.
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def default_buckets(name: str) -> Optional[Tuple[float, ...]]:
+    """The fixed bucket bounds a histogram named ``name`` gets when the
+    caller supplies none: seconds-valued instruments (``*_s``) take
+    :data:`LATENCY_BUCKETS_S`; everything else keeps reservoir-only
+    percentiles (no ``_bucket`` exposition)."""
+    return LATENCY_BUCKETS_S if name.endswith("_s") else None
 
 
 def nearest_rank_percentiles(samples: Sequence[float],
@@ -118,12 +137,19 @@ class Histogram:
     unbounded stream cost O(reservoir) memory.  The replacement RNG is
     seeded per instrument — re-analyzing the same run reproduces the
     same percentiles bit for bit.
+
+    ``buckets`` (optional, sorted upper bounds) additionally keeps
+    EXACT per-bucket counts, so the Prometheus exporter can render a
+    true cumulative ``_bucket{le=...}`` family external alerting can
+    ``rate()`` — something the reservoir cannot reconstruct (ISSUE 20
+    satellite).  The bounds are fixed for the instrument's lifetime.
     """
 
     __slots__ = ("_lock", "_res", "_cap", "_rng", "count", "sum",
-                 "min", "max")
+                 "min", "max", "_bounds", "_bucket_counts")
 
-    def __init__(self, reservoir: int = 512, seed: int = 0):
+    def __init__(self, reservoir: int = 512, seed: int = 0,
+                 buckets: Optional[Sequence[float]] = None):
         self._lock = threading.Lock()
         self._res: List[float] = []
         self._cap = max(1, int(reservoir))
@@ -132,6 +158,11 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._bounds: Optional[Tuple[float, ...]] = (
+            tuple(sorted(float(b) for b in buckets)) if buckets else None)
+        # one slot per bound plus the +Inf overflow slot
+        self._bucket_counts: Optional[List[int]] = (
+            [0] * (len(self._bounds) + 1) if self._bounds else None)
 
     def observe(self, v) -> None:
         v = float(v)
@@ -140,12 +171,30 @@ class Histogram:
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            if self._bucket_counts is not None:
+                self._bucket_counts[
+                    bisect.bisect_left(self._bounds, v)] += 1
             if len(self._res) < self._cap:
                 self._res.append(v)
             else:
                 j = self._rng.randrange(self.count)
                 if j < self._cap:
                     self._res[j] = v
+
+    def bucket_counts(self):
+        """``(bounds, cumulative_counts)`` — counts[i] is the number of
+        observations ``<= bounds[i]`` (the Prometheus ``le`` contract;
+        the implicit ``+Inf`` bucket is :attr:`count`).  ``None`` when
+        the instrument was built without bounds."""
+        if self._bounds is None:
+            return None
+        with self._lock:
+            raw = list(self._bucket_counts)
+        cum, running = [], 0
+        for c in raw[:-1]:
+            running += c
+            cum.append(running)
+        return self._bounds, cum
 
     def percentiles(self, qs: Sequence[float] = (50.0, 90.0, 99.0)):
         """Reservoir percentiles (nearest-rank); [] -> all None."""
@@ -159,12 +208,16 @@ class Histogram:
 
     def snapshot(self):
         p50, p90, p99 = self.percentiles((50.0, 90.0, 99.0))
-        return {"count": self.count,
-                "sum": round(self.sum, 6),
-                "min": self.min, "max": self.max,
-                "mean": (round(self.mean, 6)
-                         if self.count else None),
-                "p50": p50, "p90": p90, "p99": p99}
+        out = {"count": self.count,
+               "sum": round(self.sum, 6),
+               "min": self.min, "max": self.max,
+               "mean": (round(self.mean, 6)
+                        if self.count else None),
+               "p50": p50, "p90": p90, "p99": p99}
+        bc = self.bucket_counts()
+        if bc is not None:
+            out["buckets"] = {"le": list(bc[0]), "counts": bc[1]}
+        return out
 
 
 class Rolling:
@@ -280,14 +333,20 @@ class MetricsRegistry:
     def gauge(self, name: str):
         return self._get(self._gauges, name, Gauge)
 
-    def histogram(self, name: str):
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None):
         # Deterministic per-name seed (crc32, not hash(): str hashing is
-        # salted per process): same run, same reservoir.
+        # salted per process): same run, same reservoir.  Bucket bounds
+        # bind on FIRST creation (fixed-per-histogram contract); omitted,
+        # `*_s` names get the shared latency ladder (default_buckets).
         import zlib
+        if buckets is None:
+            buckets = default_buckets(name)
         return self._get(
             self._hists, name,
             lambda: Histogram(self._reservoir,
-                              seed=zlib.crc32(name.encode())))
+                              seed=zlib.crc32(name.encode()),
+                              buckets=buckets))
 
     def snapshot(self) -> dict:
         """One nested dict of every instrument's current value."""

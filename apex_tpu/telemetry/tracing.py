@@ -1,0 +1,159 @@
+"""Request-level tracing — deterministic trace/span ids over the event
+stream (ISSUE 20 tentpole, piece 1).
+
+The recorder's aggregate histograms say *how slow* serving is; they
+cannot say *why request 7 took 900 ms*.  This module adds the missing
+per-request view with the same zero-marginal-cost discipline as the
+rest of the telemetry engine:
+
+* a **trace** is one request's journey (submit → queue → prefill →
+  decode steps → done); a **span** is one timed phase of it, emitted
+  through the existing :class:`~apex_tpu.telemetry.events.Recorder` as
+  ``span`` events — rotation, the watchdog fold, the exporter tick,
+  and ``prof.fleet`` multi-host reassembly all work unchanged;
+* ids are **deterministic and counter-based** (``t<host>-<n>`` /
+  ``s<n>``): no wall-clock or RNG entropy on the hot path, so the same
+  load replayed produces the same tree and the disabled path stays
+  bitwise-identical to an uninstrumented build;
+* **sampling** bounds the overhead: ``sample_n=N`` traces every Nth
+  sampled unit (request), ``sample_n=0`` (the default when
+  ``APEX_TPU_TRACE_SAMPLE`` is unset) traces nothing.  Untraced
+  requests pay ONE counter increment at submit and nothing per token —
+  the established 1.5x telemetry overhead gate holds with
+  ``sample_n=1`` (``bench.py`` gates it);
+* with **no recorder installed** every entry point is a strict no-op:
+  :func:`get_tracer` returns ``None`` and the instrumented call sites
+  reduce to the same one-global-read the rest of telemetry pays.
+
+Span event schema (one JSONL line per finished span)::
+
+    {"t": <end, stream clock>, "kind": "span", "name": "prefill",
+     "trace": "t0-000007", "span": "s000042", "parent": "s000041",
+     "dur": 0.0183, ...free-form fields (slot/bucket/batch_size/...)}
+
+``t`` is the span's END on the stream clock and ``dur`` its length —
+the same convention as ``window`` events, so ``start = t - dur`` and
+the Chrome exporter renders spans without a special case.  The root
+span of a trace has no ``parent``.  Offline reassembly:
+``python -m apex_tpu.prof.requests`` (waterfalls, TTFT/TPOT
+percentiles, goodput, the batch-size/TPOT join).
+
+Usage::
+
+    rec = telemetry.start("run.jsonl", trace_sample_n=1)   # or env
+    tr = rec.tracer
+    trace = tr.sample()                  # every Nth call -> a trace id
+    if trace is not None:
+        root = tr.emit("request", trace, dur=total_s)
+        with tr.span("prefill", trace, parent=root, slot=0):
+            ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["Tracer", "attach", "sample_n_from_env"]
+
+
+def sample_n_from_env() -> int:
+    """``APEX_TPU_TRACE_SAMPLE`` as an int (0 / unset / garbage -> 0,
+    i.e. tracing off) — the flags-free wiring ``telemetry.start`` uses."""
+    raw = (os.environ.get("APEX_TPU_TRACE_SAMPLE") or "").strip()
+    try:
+        return max(0, int(raw)) if raw else 0
+    except ValueError:
+        return 0
+
+
+class Tracer:
+    """Deterministic id factory + ``span`` event emitter for one
+    recorder.
+
+    ``sample()`` is the sampling gate: every ``sample_n``-th call
+    returns a fresh trace id (the caller traces that unit), the rest
+    return ``None`` (the caller emits nothing).  ``sample_n <= 0``
+    never samples.  Counters are plain itertools counters under a lock
+    — cheap, deterministic, and unique per process; the trace id embeds
+    the recorder's ``process_index`` so merged multi-host streams never
+    collide."""
+
+    def __init__(self, recorder, sample_n: int = 1):
+        self._rec = recorder
+        self.sample_n = int(sample_n)
+        self._lock = threading.Lock()
+        self._seen = 0                       # sampling-unit counter
+        self._traces = itertools.count()     # allocated trace ids
+        self._spans = itertools.count()      # allocated span ids
+        self._host = int(getattr(recorder, "process_index", 0) or 0)
+
+    # -- ids ----------------------------------------------------------------
+    def sample(self) -> Optional[str]:
+        """One sampling decision: a new trace id for every
+        ``sample_n``-th call, else ``None``.  Thread-safe (submit runs
+        on caller threads)."""
+        if self.sample_n <= 0:
+            return None
+        with self._lock:
+            n = self._seen
+            self._seen += 1
+            if n % self.sample_n:
+                return None
+            return f"t{self._host}-{next(self._traces):06d}"
+
+    def next_span_id(self) -> str:
+        """A fresh span id (unique within this process' stream)."""
+        with self._lock:
+            return f"s{next(self._spans):06d}"
+
+    # -- emission -----------------------------------------------------------
+    def emit(self, name: str, trace: Optional[str], *,
+             parent: Optional[str] = None, dur: float = 0.0,
+             span: Optional[str] = None, **fields) -> Optional[str]:
+        """Emit one already-measured span (the engine times a batched
+        decode dispatch ONCE and emits a span per traced participant).
+        ``trace=None`` is the not-sampled no-op; returns the span id so
+        children can parent to it."""
+        if trace is None:
+            return None
+        rec = self._rec
+        if rec is None or not rec.enabled:
+            return None
+        sid = span if span is not None else self.next_span_id()
+        if parent is not None:
+            fields["parent"] = parent
+        rec.event("span", name=name, trace=trace, span=sid,
+                  dur=round(float(dur), 6), **fields)
+        return sid
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace: Optional[str], *,
+             parent: Optional[str] = None, **fields):
+        """Context manager measuring and emitting one span; yields the
+        span id (``None`` when the trace is unsampled — the strict
+        no-op path: no clock read, no allocation)."""
+        if trace is None:
+            yield None
+            return
+        sid = self.next_span_id()
+        t0 = time.perf_counter()
+        try:
+            yield sid
+        finally:
+            self.emit(name, trace, parent=parent, span=sid,
+                      dur=time.perf_counter() - t0, **fields)
+
+
+def attach(recorder, sample_n: int = 1) -> Tracer:
+    """Build a :class:`Tracer` and hook it onto ``recorder``
+    (``telemetry.start(trace_sample_n=...)`` calls this).  Returns the
+    tracer; instrumented subsystems discover it via
+    ``recorder.tracer``."""
+    tr = Tracer(recorder, sample_n=sample_n)
+    recorder.attach_tracer(tr)
+    return tr

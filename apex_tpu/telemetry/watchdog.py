@@ -60,6 +60,15 @@ Rules (each a small stateful fold; thresholds are constructor kwargs):
                           gone stale (activations drifted past the frozen
                           int8 range) and the quantizer is clipping;
                           re-observe and re-freeze (ISSUE 13)
+``slo_burn``              an ``slo`` evaluation reports BOTH burn windows
+                          above ``slo_burn_rate`` — the serving SLO's error
+                          budget is being spent faster than the target
+                          allows, sustained (the classic multi-window
+                          burn-rate alert; ISSUE 20)
+``slo_exhausted``         the run-level error budget is GONE: the bad
+                          fraction over everything served exceeds the
+                          budget — the SLO cannot be met without a quiet
+                          stretch; shed load or scale out (critical)
 ========================  =====================================================
 
 Usage — the examples' ``--watchdog`` flag does exactly this::
@@ -83,7 +92,7 @@ __all__ = ["Watchdog", "attach", "RULE_NAMES"]
 RULE_NAMES = ("nonfinite", "scale_collapse", "loader_stall", "step_time",
               "retrace_storm", "checkpoint_stall", "checkpoint_failed",
               "memory_headroom", "serving_queue_stall",
-              "quant_scale_saturation")
+              "quant_scale_saturation", "slo_burn", "slo_exhausted")
 
 
 class _Rule:
@@ -428,6 +437,58 @@ class _QuantScaleSaturation(_Rule):
                            f"re-freeze the calibration"}
 
 
+class _SLOBurn(_Rule):
+    """The serving SLO's sustained burn alarm (ISSUE 20): the
+    :class:`apex_tpu.telemetry.slo.SLOEngine` folds ``done`` events
+    into short/long-window burn rates and emits ``slo`` evaluations;
+    this rule fires when BOTH windows burn above ``slo_burn_rate`` —
+    the short window makes the alert fast, the long window makes it
+    evidence of a trend rather than one slow request (the standard
+    multi-window burn-rate page).  Warning severity: the budget is
+    being spent, not yet gone."""
+
+    name = "slo_burn"
+
+    def __init__(self, slo_burn_rate: float = 1.0):
+        self.slo_burn_rate = float(slo_burn_rate)
+
+    def observe(self, event):
+        if event.get("kind") != "slo" or event.get("phase") != "eval":
+            return None
+        short = float(event.get("burn_short", 0.0) or 0.0)
+        long_ = float(event.get("burn_long", 0.0) or 0.0)
+        if short <= self.slo_burn_rate or long_ <= self.slo_burn_rate:
+            return None
+        return {"step": None, "value": round(long_, 3),
+                "message": f"SLO error budget burning {short:.1f}x/"
+                           f"{long_:.1f}x (short/long windows, both > "
+                           f"{self.slo_burn_rate:g}x) — goodput "
+                           f"{event.get('goodput_pct')}% vs target "
+                           f"{event.get('target_pct')}%"}
+
+
+class _SLOExhausted(_Rule):
+    """The run-level SLO budget is spent (ISSUE 20): the bad fraction
+    over EVERYTHING served exceeds the allowance, so no remaining
+    traffic mix can bring this run back inside its target — the
+    scale-out/shed-load page.  Critical, debounced like the rest."""
+
+    name = "slo_exhausted"
+    severity = "critical"
+
+    def observe(self, event):
+        if event.get("kind") != "slo" or event.get("phase") != "eval" \
+                or not event.get("exhausted"):
+            return None
+        return {"step": None, "value": event.get("goodput_pct"),
+                "message": f"SLO error budget EXHAUSTED: "
+                           f"{event.get('bad')}/{event.get('n')} requests "
+                           f"out of SLO (target "
+                           f"{event.get('target_pct')}%) — the run can "
+                           f"no longer meet its objectives; shed load "
+                           f"or add capacity"}
+
+
 class Watchdog:
     """Folds recorder events through the rule set and emits debounced
     ``alert`` events back into the same stream.
@@ -469,6 +530,9 @@ class Watchdog:
                 _QuantScaleSaturation(
                     quant_max_exceeded=thresholds.get(
                         "quant_max_exceeded", 4)),
+                _SLOBurn(
+                    slo_burn_rate=thresholds.get("slo_burn_rate", 1.0)),
+                _SLOExhausted(),
             ]
         self.rules = rules
         self.alerts: List[Dict[str, Any]] = []

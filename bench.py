@@ -1501,7 +1501,10 @@ def _bench_serving():
         hotswap_ok = (staged and eng.stats["hotswaps"] == 1
                       and np.array_equal(post.tokens, ref.tokens))
         misses = int(eng.stats["aot_misses"])
+        tracing = _serving_trace_probe(model, params_v2, buckets, page,
+                                       max_seqs, max_new, prompts)
         return {
+            "tracing": tracing,
             "n_requests": n_requests,
             "buckets": list(buckets),
             "max_seqs": max_seqs,
@@ -1524,6 +1527,109 @@ def _bench_serving():
     finally:
         eng.close()
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _serving_trace_probe(model, params, buckets, page, max_seqs,
+                         max_new, prompts):
+    """ISSUE 20 self-validation: the request-tracing/SLO surface.
+
+    * **tracing must not steer generation** — tokens with
+      ``trace_sample_n=1`` + an SLO fold attached are BITWISE identical
+      to a recorder-less engine's over the same prompts (greedy decode
+      is deterministic, so this is an equality);
+    * **no tracer, no spans** — a telemetry run without a tracer emits
+      ZERO ``span`` events (the strict-no-op contract);
+    * **overhead** — full sampling + SLO stays within the telemetry
+      engine's ``_TEL_OVERHEAD_GATE`` of the recorder-less wall
+      (min-of-3 loads on a warmed engine);
+    * **offline == online** — ``prof.requests`` re-derives TTFT/TPOT
+      percentiles from the stream's ``done`` events within 2% of the
+      engine's own in-run reservoirs (both use the one shared
+      nearest-rank definition), and reports goodput against the SLO
+      spec the run served under.
+    """
+    import shutil
+    import tempfile
+
+    from apex_tpu import serving, telemetry
+    from apex_tpu.prof import requests as prof_requests
+
+    probe = prompts[:8]
+    slo_spec = "ttft_p99<60s,tpot_p99<60s"   # gates mechanism, not speed
+    d = tempfile.mkdtemp(prefix="apex_tpu_bench_trace_")
+    stream = os.path.join(d, "serve.jsonl")
+
+    def load(rec, reps):
+        eng = serving.ServingEngine(model, params, buckets=buckets,
+                                    page_size=page, max_seqs=max_seqs,
+                                    telemetry=rec)
+        try:
+            eng.warmup()
+            best, toks = float("inf"), None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                res = eng.generate(probe, max_new_tokens=max_new)
+                best = min(best, time.perf_counter() - t0)
+                if toks is None:
+                    toks = [np.asarray(r.tokens) for r in res]
+            return best, toks
+        finally:
+            eng.close()
+
+    try:
+        wall_off, toks_off = load(None, reps=3)
+
+        # no tracer attached -> the stream must hold zero span events
+        rec0 = telemetry.start(os.path.join(d, "notrace.jsonl"),
+                               trace_sample_n=0)
+        load(rec0, reps=1)
+        rec0.close()
+        with open(os.path.join(d, "notrace.jsonl")) as f:
+            dark_spans = sum(1 for ln in f if '"kind": "span"' in ln)
+
+        rec = telemetry.start(stream, watchdog=True, trace_sample_n=1,
+                              slo=slo_spec, example="bench_trace")
+        wall_on, toks_on = load(rec, reps=3)
+        eng_p = {
+            name: rec.metrics.histogram(f"serving_{name}_s")
+                     .percentiles((50.0, 99.0))
+            for name in ("ttft", "tpot")}
+        rec.close()
+
+        bitwise_ok = (len(toks_off) == len(toks_on) and all(
+            np.array_equal(a, b) for a, b in zip(toks_off, toks_on)))
+
+        events = prof_requests.load_request_events([stream])
+        a = prof_requests.analyze(events, slo=slo_spec)
+        spans = sum(1 for e in events if e.get("kind") == "span")
+        agree = []
+        for name in ("ttft", "tpot"):
+            st = (a["requests"] or {}).get(name) or {}
+            for q, ms_key in ((0, "p50_ms"), (1, "p99_ms")):
+                eng_v, ana_ms = eng_p[name][q], st.get(ms_key)
+                if eng_v and ana_ms is not None:
+                    agree.append(abs(ana_ms / 1e3 - eng_v) / eng_v * 100)
+        slo_res = a.get("slo") or {}
+        return {
+            "tokens_bitwise_ok": bool(bitwise_ok),
+            "zero_spans_without_tracer": dark_spans == 0,
+            "overhead_ratio": (round(wall_on / wall_off, 3)
+                               if wall_off > 0 else None),
+            "overhead_gate": _TEL_OVERHEAD_GATE,
+            "span_events": spans,
+            "sampled_requests": a.get("n_sampled", 0),
+            "analyzer_vs_engine_pct": (round(max(agree), 3)
+                                       if agree else None),
+            "analyzer_ttft_p99_ms": ((a["requests"] or {}).get("ttft")
+                                     or {}).get("p99_ms"),
+            "engine_ttft_p99_ms": (round(eng_p["ttft"][1] * 1e3, 3)
+                                   if eng_p["ttft"][1] else None),
+            "slo_spec": slo_spec,
+            "goodput_pct": slo_res.get("goodput_pct"),
+            "slo_met": slo_res.get("met"),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def _pct(sorted_vals, q):
@@ -2562,6 +2668,41 @@ def main():
             f"page(s) still held after the load drained — the scheduler "
             f"leaks pages on eviction and a long-running server would "
             f"strand its whole pool; refusing to report.")
+    # Request-tracing/SLO self-validation (ISSUE 20), backend-independent.
+    trc = srv["tracing"]
+    if not trc["tokens_bitwise_ok"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: enabling request tracing "
+            f"(trace_sample_n=1 + SLO fold) changed the generated "
+            f"tokens — observability steered the decode path; the "
+            f"traced engine must be bitwise identical; refusing to "
+            f"report.")
+    if not trc["zero_spans_without_tracer"]:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: a telemetry run with NO tracer "
+            f"attached emitted span events — the strict-no-op contract "
+            f"of the disabled tracing path broke; refusing to report.")
+    if trc["overhead_ratio"] and trc["overhead_ratio"] > _TEL_OVERHEAD_GATE:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: serving with full request "
+            f"tracing ran {trc['overhead_ratio']}x the recorder-less "
+            f"load (> {_TEL_OVERHEAD_GATE}x gate) — span emission "
+            f"leaked onto the scheduler hot path; refusing to report.")
+    if trc["analyzer_vs_engine_pct"] is None \
+            or trc["analyzer_vs_engine_pct"] > 2.0:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: prof.requests re-derived "
+            f"TTFT/TPOT {trc['analyzer_vs_engine_pct']}% away from the "
+            f"engine's in-run reservoirs (gate 2%; analyzer ttft p99 "
+            f"{trc['analyzer_ttft_p99_ms']} vs engine "
+            f"{trc['engine_ttft_p99_ms']} ms) — the offline and online "
+            f"percentile paths diverged; refusing to report.")
+    if trc["goodput_pct"] is None:
+        raise SystemExit(
+            f"BENCH SELF-CHECK FAILED: the SLO evaluation returned no "
+            f"goodput for spec {trc['slo_spec']!r} — the done events "
+            f"lost their latency fields or the offline evaluator "
+            f"matched zero requests; refusing to report.")
 
     # int8 engine self-validation (ISSUE 13): equal-HBM KV capacity and
     # the committed convergence artifact are backend-independent gates;
@@ -2869,6 +3010,12 @@ def main():
             "serving_tokens_per_s": extra["serving"].get("tokens_per_s"),
             "serving_p99_latency_ms": (
                 extra["serving"].get("p99_latency_ms")),
+            "serving_trace_overhead_ratio": (
+                extra["serving"]["tracing"].get("overhead_ratio")),
+            "serving_goodput_pct": (
+                extra["serving"]["tracing"].get("goodput_pct")),
+            "serving_ttft_p99_ms": (
+                extra["serving"]["tracing"].get("analyzer_ttft_p99_ms")),
             "quant_matmul_o4_over_bf16": (
                 extra["quant"]["matmul"].get("o4_over_bf16")),
             "quant_lm_ms_per_step_o4": (

@@ -10,6 +10,9 @@ checkpoint directory, and the live ``serving_*`` gauges through
     python serve_lm.py --requests 16 --max-new 16
     python serve_lm.py --checkpoint-dir /ckpts --watch --telemetry s.jsonl
     python serve_lm.py --requests 64 --buckets 128,256 --max-seqs 8
+    python serve_lm.py --telemetry s.jsonl --trace-sample 1 \
+        --slo 'ttft_p99<200ms,tpot_p99<30ms'
+    # then: python -m apex_tpu.prof.requests s.jsonl --slo ...
 """
 
 import os as _os
@@ -51,12 +54,25 @@ def main():
                          "newly committed checkpoints with zero downtime")
     ap.add_argument("--telemetry", default=None,
                     help="JSONL stream path (env APEX_TPU_TELEMETRY)")
+    ap.add_argument("--trace-sample", type=int, default=None,
+                    metavar="N",
+                    help="trace every Nth request as a span tree in the "
+                         "telemetry stream (0/unset = off; env "
+                         "APEX_TPU_TRACE_SAMPLE); analyze with "
+                         "python -m apex_tpu.prof.requests")
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="serve against a latency SLO, e.g. "
+                         "'ttft_p99<200ms,tpot_p99<30ms' (env "
+                         "APEX_TPU_SLO): live goodput/burn-rate gauges "
+                         "+ slo_burn/slo_exhausted watchdog alerts")
     args = ap.parse_args()
 
     rec = None
     if args.telemetry or (_os.environ.get("APEX_TPU_TELEMETRY") or "").strip():
         rec = telemetry.start(args.telemetry, watchdog=True,
-                              example="serve_lm")
+                              example="serve_lm",
+                              trace_sample_n=args.trace_sample,
+                              slo=args.slo)
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
     model = gpt_tiny(max_len=max(buckets))
@@ -95,13 +111,34 @@ def main():
               f"({eng.stats['tokens_out'] / wall:.1f} tok/s), "
               f"p99 latency {p99 * 1e3:.1f} ms, "
               f"aot_misses {eng.stats['aot_misses']}, "
+              f"rejected {eng.stats['rejected']}, "
               f"hotswaps {eng.stats['hotswaps']}")
+        # per-request latency split (ISSUE 20): TTFT is what an
+        # interactive caller feels, TPOT is the streaming rate after it
+        ttfts = sorted(r.timings["ttft_s"] for r in ok
+                       if r.timings.get("ttft_s") is not None)
+        tpots = sorted(r.timings["tpot_s"] for r in ok
+                       if r.timings.get("tpot_s") is not None)
+        if ttfts:
+            def _p(v, q):
+                return v[min(len(v) - 1, int(q * (len(v) - 1)))] * 1e3
+            print(f"ttft p50 {_p(ttfts, 0.5):.1f} / "
+                  f"p99 {_p(ttfts, 0.99):.1f} ms"
+                  + (f", tpot p50 {_p(tpots, 0.5):.2f} / "
+                     f"p99 {_p(tpots, 0.99):.2f} ms" if tpots else ""))
     finally:
         eng.close()
         if rec is not None:
+            slo_eng = rec.slo
             rec.close()
+            if slo_eng is not None and slo_eng.last is not None:
+                print("slo:", slo_eng.format_line())
             if rec.watchdog is not None:
                 print("health:", rec.watchdog.format_line())
+            if args.telemetry and args.trace_sample:
+                print(f"traces: python -m apex_tpu.prof.requests "
+                      f"{args.telemetry}"
+                      + (f" --slo '{args.slo}'" if args.slo else ""))
 
 
 if __name__ == "__main__":

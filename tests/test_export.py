@@ -89,6 +89,32 @@ def test_render_families(tmp_path):
     rec.close()
 
 
+def test_render_cumulative_histogram(tmp_path):
+    """ISSUE 20 satellite: ``*_s`` histograms expose a TRUE cumulative
+    ``_bucket{le=...}`` family with fixed bounds (rate()-able by
+    external alerting) alongside the summary-style quantile gauges —
+    counts cumulative in ``le``, a ``+Inf`` terminal equal to ``_count``,
+    and sum/count consistent between the two families."""
+    rec = telemetry.start(str(tmp_path / "r.jsonl"))
+    h = rec.metrics.histogram("serving_ttft_s")
+    for v in (0.004, 0.02, 0.02, 3.0):
+        h.observe(v)
+    rec.metrics.histogram("unbounded_things").observe(1.0)
+    text = tel_export.render(rec)
+    assert "# TYPE apex_tpu_serving_ttft_s_hist histogram" in text
+    assert 'apex_tpu_serving_ttft_s_hist_bucket{le="0.005"} 1' in text
+    assert 'apex_tpu_serving_ttft_s_hist_bucket{le="0.025"} 3' in text
+    assert 'apex_tpu_serving_ttft_s_hist_bucket{le="2.5"} 3' in text
+    assert 'apex_tpu_serving_ttft_s_hist_bucket{le="5"} 4' in text
+    assert 'apex_tpu_serving_ttft_s_hist_bucket{le="+Inf"} 4' in text
+    assert "apex_tpu_serving_ttft_s_hist_count 4" in text
+    # the summary family is still present under the original name
+    assert "# TYPE apex_tpu_serving_ttft_s summary" in text
+    # non-`_s` instruments stay reservoir-only: no _bucket series
+    assert "apex_tpu_unbounded_things_hist_bucket" not in text
+    rec.close()
+
+
 def test_render_nonfinite_values(tmp_path):
     """A NaN/inf gauge (an overflow-skipped window's loss) renders as
     the legal Prometheus literals instead of crashing the textfile

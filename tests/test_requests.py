@@ -1,0 +1,253 @@
+"""Offline per-request analyzer (ISSUE 20 tentpole, piece 4):
+``python -m apex_tpu.prof.requests``.
+
+The acceptance pins:
+
+* **percentile math** on hand-built streams is exact (the shared
+  nearest-rank definition — the same numbers the engine's reservoirs
+  report, which bench.py gates within 2% end to end);
+* **waterfall reassembly** orders spans by start and anchors each
+  trace on its single ``request`` root;
+* **multi-host merge** shifts every host's events onto host 0's clock
+  through the fleet alignment path and keeps all requests;
+* **CLI e2e** over a real traced engine run: report, ``--json``,
+  ``--slo`` goodput, and a ``--chrome`` export with one process lane
+  per sampled request;
+* **schema/CI**: the timeline analysis is now schema 1.2 with a
+  ``requests`` section, and ``prof.regress`` round-trips a 1.1
+  artifact against a 1.2 one (minor bump, no future-major refusal).
+"""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.models import gpt_tiny
+from apex_tpu.prof import regress, timeline
+from apex_tpu.prof import requests as prof_requests
+
+VOCAB = 256
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.set_recorder(None)
+    yield
+    telemetry.set_recorder(None)
+
+
+def _done(t, ttft, tpot, total, queue_wait, n_tokens=4, **extra):
+    return {"t": t, "kind": "serving", "phase": "done", "ttft_s": ttft,
+            "tpot_s": tpot, "total_s": total, "queue_wait_s": queue_wait,
+            "n_tokens": n_tokens, **extra}
+
+
+def _span(t, name, trace, span, dur, parent=None, **fields):
+    e = {"t": t, "kind": "span", "name": name, "trace": trace,
+         "span": span, "dur": dur, **fields}
+    if parent is not None:
+        e["parent"] = parent
+    return e
+
+
+# -- pure analysis ------------------------------------------------------------
+
+def test_request_stats_percentiles_and_batch_join():
+    events = [_done(float(i), ttft=0.01 * (i + 1), tpot=0.001,
+                    total=0.1, queue_wait=0.0) for i in range(10)]
+    events += [{"t": 20.0 + i, "kind": "serving", "phase": "decode",
+                "dur": 0.002 * bs, "active": bs}
+               for i, bs in enumerate([1, 1, 2, 2, 2, 4])]
+    st = prof_requests.request_stats(events)
+    assert st["n_requests"] == 10 and st["tokens_out"] == 40
+    # nearest-rank over 0.01..0.10: p50 = idx round(4.5) -> 0.05s
+    assert st["ttft"]["p50_ms"] == pytest.approx(50.0)
+    assert st["ttft"]["p99_ms"] == pytest.approx(100.0)
+    assert st["tpot"]["p50_ms"] == pytest.approx(1.0)
+    curve = {r["batch_size"]: r for r in st["batch_tpot"]}
+    assert curve[1]["steps"] == 2
+    assert curve[2]["mean_step_ms"] == pytest.approx(4.0)
+    assert curve[4]["steps"] == 1
+    # a stream with no serving traffic has no requests section
+    assert prof_requests.request_stats(
+        [{"t": 0.0, "kind": "window"}]) is None
+
+
+def test_waterfalls_order_and_root():
+    tr = "t0-000000"
+    events = [
+        # emitted root-after-children order, ends as timestamps:
+        _span(1.30, "request", tr, "s0", 1.30),
+        _span(0.20, "queue", tr, "s1", 0.20, parent="s0", slot=0),
+        _span(0.45, "prefill", tr, "s2", 0.25, parent="s0",
+              prompt_len=7),
+        _span(0.90, "decode_step", tr, "s3", 0.05, parent="s0",
+              batch_size=2),
+    ]
+    [w] = prof_requests.build_waterfalls(events)
+    assert w["trace"] == tr and w["n_spans"] == 4
+    assert w["e2e_ms"] == pytest.approx(1300.0)
+    assert w["decode_steps"] == 1
+    # sorted by start = t - dur: queue (0.0) = request (0.0, longer
+    # first loses to equal start? queue dur shorter sorts after) ...
+    starts = [r["start_s"] for r in w["spans"]]
+    assert starts == sorted(starts)
+    assert w["spans"][0]["name"] == "request"     # longest at t=0
+    assert w["spans"][1]["name"] == "queue"
+
+
+def test_analyze_goodput_and_online_summary():
+    events = ([_done(float(i), 0.01, 0.001, 0.05, 0.0)
+               for i in range(8)]
+              + [_done(9.0, 0.9, 0.001, 1.0, 0.0)]
+              + [{"t": 10.0, "kind": "summary",
+                  "slo": {"goodput_pct": 88.9}}])
+    a = prof_requests.analyze(events, slo="ttft_p90<100ms")
+    assert a["requests"]["n_requests"] == 9
+    assert a["slo"]["good"] == 8
+    assert a["slo"]["goodput_pct"] == pytest.approx(100.0 * 8 / 9,
+                                                    abs=1e-3)
+    assert a["slo_online"]["goodput_pct"] == 88.9
+    assert "goodput" in prof_requests.format_report(a)
+
+
+def test_multihost_merge_onto_host0_clock(tmp_path):
+    """Two hosts, anchors 100s apart: the merge lands both hosts'
+    requests on host 0's stream clock and keeps every done event."""
+    paths = []
+    for host, anchor in ((0, 1000.0), (1, 1100.0)):
+        p = tmp_path / f"serve_host{host}.jsonl"
+        events = [{"t": 0.0, "kind": "run", "run_id": f"r{host}",
+                   "process_index": host, "process_count": 2,
+                   "anchor_unix": anchor},
+                  _done(5.0 + host, 0.01, 0.001, 0.05, 0.0)]
+        with open(p, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        paths.append(str(p))
+    merged = prof_requests.load_request_events(paths)
+    dones = [e for e in merged if e.get("phase") == "done"]
+    assert len(dones) == 2
+    assert sorted(e["host"] for e in dones) == [0, 1]
+    # host 1's done at local t=6 + (1100-1000) anchor delta = 106 on
+    # host 0's clock (no window overlap -> no residual skew term)
+    t_by_host = {e["host"]: e["t"] for e in dones}
+    assert t_by_host[0] == pytest.approx(5.0)
+    assert t_by_host[1] == pytest.approx(106.0)
+    st = prof_requests.request_stats(merged)
+    assert st["n_requests"] == 2
+
+
+# -- CLI e2e over a real traced run -------------------------------------------
+
+@pytest.fixture(scope="module")
+def traced_stream(tmp_path_factory):
+    """One real engine load with full sampling + SLO, shared by the
+    CLI tests."""
+    d = tmp_path_factory.mktemp("requests_cli")
+    path = str(d / "serve.jsonl")
+    m = gpt_tiny(max_len=64, vocab_size=VOCAB, hidden_size=64,
+                 num_layers=2, num_heads=2, mlp_dim=128)
+    rs = np.random.RandomState(0)
+    probe = jnp.asarray(rs.randint(1, VOCAB, (1, 8)))
+    params = m.init(jax.random.PRNGKey(1), probe)["params"]
+    rec = telemetry.start(path, watchdog=True, trace_sample_n=1,
+                          slo="ttft_p99<60s,tpot_p99<60s")
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2, telemetry=rec)
+    eng.warmup()
+    prompts = [rs.randint(1, VOCAB, (int(n),)).astype(np.int32)
+               for n in rs.randint(3, 10, 5)]
+    eng.generate(prompts, max_new_tokens=4)
+    eng.close()
+    rec.close()
+    telemetry.set_recorder(None)
+    return path
+
+
+def test_cli_report_and_json(traced_stream, capsys):
+    assert prof_requests.main([traced_stream]) == 0
+    out = capsys.readouterr().out
+    assert "5 finished" in out and "ttft" in out and "trace t0-" in out
+    assert prof_requests.main(
+        [traced_stream, "--json", "--slo", "ttft_p99<60s"]) == 0
+    a = json.loads(capsys.readouterr().out)
+    assert a["requests"]["n_requests"] == 5
+    assert a["n_sampled"] == 5
+    assert a["slo"]["met"] is True
+    assert a["slo"]["goodput_pct"] == 100.0
+    # every waterfall is a rooted tree with decode steps
+    for w in a["waterfalls"]:
+        assert w["e2e_ms"] is not None and w["decode_steps"] > 0
+
+
+def test_cli_chrome_one_lane_per_request(traced_stream, tmp_path):
+    out = str(tmp_path / "req.trace.json")
+    assert prof_requests.main([traced_stream, "--chrome", out]) == 0
+    with open(out) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    lanes = {e["pid"] for e in evs}
+    assert len(lanes) == 5                        # one pid per request
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert all(n.startswith("req t0-") for n in names)
+    # spans became X slices with positive duration
+    assert any(e["ph"] == "X" and e.get("dur", 0) > 0 for e in evs)
+
+
+def test_cli_missing_stream_errors(tmp_path, capsys):
+    assert prof_requests.main([str(tmp_path / "nope.jsonl")]) == 1
+    assert "error" in capsys.readouterr().err
+
+
+# -- schema / regress ---------------------------------------------------------
+
+def test_timeline_schema_1_2_requests_section(traced_stream):
+    assert timeline.SCHEMA_VERSION == "1.2"
+    a = timeline.analyze(timeline.load_events(traced_stream))
+    assert a["schema_version"] == "1.2"
+    assert a["requests"]["n_requests"] == 5
+    assert a["requests"]["ttft"]["p99_ms"] is not None
+    assert "serving: 5 requests" in timeline.format_report(a)
+    # analyzer agrees with prof.requests on the same stream (identical
+    # code path — the bench gates the engine-reservoir side)
+    st = prof_requests.request_stats(
+        prof_requests.load_request_events([traced_stream]))
+    assert a["requests"]["ttft"]["p99_ms"] == st["ttft"]["p99_ms"]
+
+
+def test_regress_roundtrips_1_1_and_1_2(traced_stream):
+    """A 1.1-era summary diffs against a 1.2 one: the minor bump must
+    not trip the future-major refusal, the new requests.* latency keys
+    are direction-classified, and histogram bucket arrays stay out of
+    the diff (lists are not metrics)."""
+    cur = timeline.analyze(timeline.load_events(traced_stream))
+    base = dict(cur, schema_version="1.1")
+    base.pop("requests")
+    timeline.check_schema_version(base)
+    timeline.check_schema_version(cur)
+    d = regress.diff_summaries(base, cur)
+    assert d["regressions"] == []                # disjoint keys skip
+    # same-schema diff classifies the new latency keys
+    d2 = regress.diff_summaries(cur, cur)
+    assert d2["regressions"] == []
+    flat = regress.flatten_metrics(cur)
+    assert any(k.startswith("requests.ttft.") for k in flat)
+    assert not any("buckets" in k for k in flat)
+    # a FUTURE major still refuses loudly
+    with pytest.raises(ValueError, match="FUTURE major"):
+        timeline.check_schema_version(dict(cur, schema_version="2.0"))
+    # goodput/burn directions (ISSUE 20): a goodput collapse past the
+    # tolerance+pct-point slack is a regression (higher-is-better)
+    down = {"slo": {"goodput_pct": 50.0}}
+    up = {"slo": {"goodput_pct": 99.0}}
+    d3 = regress.diff_summaries(up, down)
+    assert any(r["metric"] == "slo.goodput_pct"
+               for r in d3["regressions"])

@@ -350,6 +350,31 @@ def test_serving_events_and_gauges_in_stream(model_and_params, tmp_path):
     assert not any(e["kind"] == "alert" for e in events)
 
 
+def test_tokens_per_s_gauge_decays_when_idle(model_and_params, tmp_path):
+    """Regression (ISSUE 20 satellite): serving_tokens_per_s froze at
+    its last computed rate across idle gaps — a drained server scraped
+    as if it were still serving at full tilt.  With no decode landing
+    inside the idle horizon the next scheduler pass must ZERO the
+    gauge (and re-anchor cleanly when load returns)."""
+    m, params = model_and_params
+    rec = telemetry.start(str(tmp_path / "rate.jsonl"))
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2)
+    eng.warmup()
+    eng.generate([_prompt(4), _prompt(6, 1)], max_new_tokens=3)
+    g = rec.metrics.gauge("serving_tokens_per_s")
+    assert g.value is not None and g.value > 0   # live rate under load
+    eng.rate_idle_s = 0.0                        # horizon: immediate
+    eng.step()                                   # idle scheduler pass
+    assert g.value == 0.0
+    # load returns: the rate re-anchors and goes live again
+    eng.rate_idle_s = 5.0
+    eng.generate([_prompt(5, 2)], max_new_tokens=3)
+    assert g.value > 0
+    eng.close()
+    rec.close()
+
+
 def test_serving_queue_stall_alert_end_to_end(model_and_params, tmp_path):
     """A request that waits past the threshold in the queue trips the
     serving_queue_stall rule when it is finally admitted."""

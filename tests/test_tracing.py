@@ -1,0 +1,335 @@
+"""Request-level tracing + SLO engine (ISSUE 20 tentpole).
+
+The acceptance pins:
+
+* **deterministic sampling** — counter-based every-Nth, no wall-clock
+  or RNG entropy; ``sample_n=0`` never samples; the env wiring parses
+  garbage as "off";
+* **strict no-op** — no recorder (or an unsampled request) emits
+  nothing and allocates nothing observable;
+* **span-tree integrity under the threaded server** — every sampled
+  request in a ``serve_forever`` load yields exactly one root
+  ``request`` span, with queue/prefill/decode_step children all
+  parented to it, through rotation included;
+* **SLO semantics** — spec parsing, goodput evaluation, the online
+  fold's burn-rate gauges, and the ``slo_burn`` / ``slo_exhausted``
+  watchdog rules firing and recovering on a synthetic stream.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.models import gpt_tiny
+from apex_tpu.telemetry import slo as slo_mod
+from apex_tpu.telemetry import tracing
+from apex_tpu.telemetry.events import expand_stream_paths
+
+VOCAB = 256
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.set_recorder(None)
+    yield
+    telemetry.set_recorder(None)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    m = gpt_tiny(max_len=64, vocab_size=VOCAB, hidden_size=64,
+                 num_layers=2, num_heads=2, mlp_dim=128)
+    probe = jnp.asarray(np.random.RandomState(0).randint(1, VOCAB, (1, 8)))
+    params = m.init(jax.random.PRNGKey(1), probe)["params"]
+    return m, params
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, VOCAB, (n,)).astype(
+        np.int32)
+
+
+def _spans(path_or_events):
+    if isinstance(path_or_events, str):
+        events = []
+        for p in expand_stream_paths(path_or_events):
+            with open(p) as f:
+                events += [json.loads(l) for l in f if l.strip()]
+    else:
+        events = path_or_events
+    return [e for e in events if e.get("kind") == "span"]
+
+
+def _check_trees(spans):
+    """Every trace: one parentless ``request`` root, all other spans
+    parented to it.  Returns the trace->spans map."""
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    for trace, ss in by_trace.items():
+        roots = [s for s in ss if "parent" not in s]
+        assert len(roots) == 1, f"{trace}: {len(roots)} roots"
+        assert roots[0]["name"] == "request"
+        rid = roots[0]["span"]
+        for s in ss:
+            if s is not roots[0]:
+                assert s["parent"] == rid, \
+                    f"{trace}: {s['name']} parented to {s['parent']}"
+        names = {s["name"] for s in ss}
+        assert {"queue", "prefill", "decode_step"} <= names, names
+    return by_trace
+
+
+# -- sampling + id determinism ------------------------------------------------
+
+def test_sampler_every_nth_deterministic(tmp_path):
+    rec = telemetry.Recorder(str(tmp_path / "t.jsonl"))
+    tr = tracing.Tracer(rec, sample_n=3)
+    got = [tr.sample() for _ in range(9)]
+    assert got == ["t0-000000", None, None,
+                   "t0-000001", None, None,
+                   "t0-000002", None, None]
+    assert tr.next_span_id() == "s000000"
+    assert tr.next_span_id() == "s000001"
+    rec.close()
+
+
+def test_sampler_off_and_env_parse(tmp_path, monkeypatch):
+    rec = telemetry.Recorder(str(tmp_path / "t.jsonl"))
+    tr = tracing.Tracer(rec, sample_n=0)
+    assert all(tr.sample() is None for _ in range(16))
+    rec.close()
+    monkeypatch.delenv("APEX_TPU_TRACE_SAMPLE", raising=False)
+    assert tracing.sample_n_from_env() == 0
+    monkeypatch.setenv("APEX_TPU_TRACE_SAMPLE", "4")
+    assert tracing.sample_n_from_env() == 4
+    monkeypatch.setenv("APEX_TPU_TRACE_SAMPLE", "banana")
+    assert tracing.sample_n_from_env() == 0
+    monkeypatch.setenv("APEX_TPU_TRACE_SAMPLE", "-2")
+    assert tracing.sample_n_from_env() == 0
+
+
+def test_unsampled_and_closed_recorder_are_noops(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rec = telemetry.Recorder(path)
+    tr = tracing.Tracer(rec, sample_n=1)
+    # trace=None (the unsampled request): emit and span are no-ops
+    assert tr.emit("prefill", None, dur=0.1) is None
+    with tr.span("prefill", None) as sid:
+        assert sid is None
+    rec.close()
+    # a closed recorder swallows emits instead of raising
+    assert tr.emit("prefill", "t0-000000", dur=0.1) is None
+    assert _spans(path) == []
+
+
+def test_start_without_sampling_emits_no_spans(tmp_path, model_and_params):
+    """trace_sample_n=0 (the default with the env unset): a full
+    engine load writes ZERO span events — the strict no-op contract
+    the bench gates bitwise."""
+    m, params = model_and_params
+    path = str(tmp_path / "dark.jsonl")
+    rec = telemetry.start(path, trace_sample_n=0)
+    assert rec.tracer is None
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2, telemetry=rec)
+    eng.warmup()
+    eng.generate([_prompt(4), _prompt(6, 1)], max_new_tokens=3)
+    eng.close()
+    rec.close()
+    assert _spans(path) == []
+
+
+# -- engine span trees --------------------------------------------------------
+
+def test_threaded_serving_span_tree_integrity(tmp_path, model_and_params):
+    """The tentpole's integration pin: under the background
+    ``serve_forever`` scheduler with concurrent submitters, every
+    sampled request still reassembles into a single well-formed span
+    tree, and the done events carry TTFT/TPOT."""
+    m, params = model_and_params
+    path = str(tmp_path / "serve.jsonl")
+    rec = telemetry.start(path, trace_sample_n=1)
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2, telemetry=rec)
+    eng.warmup()
+    eng.start()                          # background serve thread
+    comps = []
+    lock = threading.Lock()
+
+    def submit(seed):
+        c = eng.submit(_prompt(4 + seed % 5, seed), 3)
+        with lock:
+            comps.append(c)
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [c.result(timeout=60) for c in comps]
+    eng.close()
+    rec.close()
+    assert all(r.ok for r in results)
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    by_trace = _check_trees(_spans(events))
+    assert len(by_trace) == 6                    # sample_n=1: all traced
+    dones = [e for e in events if e.get("kind") == "serving"
+             and e.get("phase") == "done"]
+    assert len(dones) == 6
+    for d in dones:
+        assert d.get("ttft_s") is not None and d["ttft_s"] > 0
+        assert d.get("trace") in by_trace
+        # TTFT is part of e2e; TPOT spreads the decode tail
+        assert d["ttft_s"] <= d["total_s"] + 1e-9
+        if d.get("tpot_s") is not None:
+            assert d["tpot_s"] >= 0
+    # results expose the same numbers to the caller
+    for r in results:
+        assert r.timings.get("ttft_s") is not None
+
+
+def test_span_trees_survive_rotation(tmp_path, model_and_params):
+    """Spans split across rotated segments reassemble into intact
+    trees via expand_stream_paths — the same reassembly prof.requests
+    uses."""
+    m, params = model_and_params
+    path = str(tmp_path / "rot.jsonl")
+    rec = telemetry.start(path, trace_sample_n=1, max_bytes=4096)
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2, telemetry=rec)
+    eng.warmup()
+    eng.generate([_prompt(4 + i % 4, i) for i in range(6)],
+                 max_new_tokens=4)
+    eng.close()
+    rec.close()
+    assert len(expand_stream_paths(path)) > 1, "load too small to rotate"
+    by_trace = _check_trees(_spans(path))
+    assert len(by_trace) == 6
+
+
+def test_traced_tokens_bitwise_vs_untraced(tmp_path, model_and_params):
+    """Tracing must observe, never steer: the traced engine's greedy
+    tokens equal the untraced engine's bitwise."""
+    m, params = model_and_params
+    prompts = [_prompt(5), _prompt(7, 1), _prompt(4, 2)]
+
+    def run(rec):
+        eng = serving.ServingEngine(m, params, buckets=(16,),
+                                    page_size=4, max_seqs=2,
+                                    telemetry=rec)
+        try:
+            eng.warmup()
+            return [np.asarray(r.tokens) for r in
+                    eng.generate(prompts, max_new_tokens=4)]
+        finally:
+            eng.close()
+
+    plain = run(None)
+    rec = telemetry.start(str(tmp_path / "on.jsonl"), trace_sample_n=1,
+                          slo="ttft_p99<60s")
+    traced = run(rec)
+    rec.close()
+    for a, b in zip(plain, traced):
+        assert np.array_equal(a, b)
+
+
+# -- SLO spec + evaluation ----------------------------------------------------
+
+def test_parse_slo_specs():
+    spec = slo_mod.parse_slo("ttft_p99<200ms, tpot_p95<=30ms")
+    assert spec.target_pct == 99.0               # max qualifier wins
+    assert [o.metric for o in spec.objectives] == ["ttft", "tpot"]
+    assert spec.objectives[0].threshold_s == pytest.approx(0.2)
+    assert spec.objectives[1].threshold_s == pytest.approx(0.03)
+    # bare metric defaults, units, seconds
+    spec2 = slo_mod.parse_slo("e2e<1.5s")
+    assert spec2.objectives[0].threshold_s == pytest.approx(1.5)
+    for bad in ("", "ttft<", "nope_p99<1ms", "ttft_p200<1ms",
+                "ttft>1ms"):
+        with pytest.raises(ValueError):
+            slo_mod.parse_slo(bad)
+
+
+def test_evaluate_goodput():
+    reqs = ([{"ttft_s": 0.01, "total_s": 0.05}] * 9
+            + [{"ttft_s": 0.50, "total_s": 0.9}])
+    out = slo_mod.evaluate("ttft_p90<100ms", reqs)
+    assert out["n_requests"] == 10 and out["good"] == 9
+    assert out["goodput_pct"] == pytest.approx(90.0)
+    assert out["met"] is True                    # target p90 -> 90%
+    [obj] = out["objectives"]
+    assert obj["ok"] is True                     # p90 of ttft <= 100ms
+    out2 = slo_mod.evaluate("ttft_p99<100ms", reqs)
+    assert out2["met"] is False                  # 90% < 99% target
+
+
+def test_slo_fold_burn_fire_and_recover(tmp_path):
+    """Synthetic stream on a deterministic clock: a burst of bad
+    requests trips slo_burn (warning) and slo_exhausted (critical);
+    a long good stretch brings the windowed burn back under 1x."""
+    path = str(tmp_path / "slo.jsonl")
+    rec = telemetry.Recorder(path)
+    from apex_tpu.telemetry import watchdog as wdog
+    wdog.attach(rec)
+    eng = slo_mod.attach(rec, "ttft_p99<100ms",
+                         short_window_s=10.0, long_window_s=50.0,
+                         eval_every=1, min_requests=8)
+
+    def done(t, ttft):
+        # events enter through Recorder.event like the engine's own,
+        # with a pinned stream clock for determinism
+        rec.event("serving", phase="done", t=t, ttft_s=ttft,
+                  total_s=ttft + 0.01, n_tokens=4)
+
+    for i in range(10):                          # all out of SLO
+        done(float(i), 0.5)
+    assert eng.last is not None
+    assert eng.last["burn_short"] > 1.0 and eng.last["burn_long"] > 1.0
+    assert eng.last["exhausted"] is True
+    rules = {a["rule"] for a in rec.watchdog.alerts}
+    assert "slo_burn" in rules and "slo_exhausted" in rules
+    # recovery: the windows slide past the bad burst
+    for i in range(10, 80):
+        done(float(i), 0.005)
+    assert eng.last["burn_short"] == 0.0
+    assert eng.last["goodput_pct"] == 100.0
+    snap = rec.metrics.snapshot()["gauges"]
+    assert snap["slo_goodput_pct"] == 100.0
+    assert snap["slo_burn_rate_short"] == 0.0
+    rec.close()
+    # the stream carries the slo evaluations and the summary the exit
+    # line reads
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    assert any(e["kind"] == "slo" for e in events)
+    summary = next(e for e in events if e["kind"] == "summary")
+    assert summary["slo"]["goodput_pct"] == 100.0
+    assert "goodput" in eng.format_line()
+
+
+def test_engine_slo_end_to_end(tmp_path, model_and_params):
+    """A real engine load under an impossible SLO: every request is
+    bad, the stream carries slo events, and the watchdog pages."""
+    m, params = model_and_params
+    path = str(tmp_path / "impossible.jsonl")
+    rec = telemetry.start(path, watchdog=True)
+    slo_mod.attach(rec, "ttft_p99<1us", eval_every=1, min_requests=4)
+    eng = serving.ServingEngine(m, params, buckets=(16,), page_size=4,
+                                max_seqs=2, telemetry=rec)
+    eng.warmup()
+    eng.generate([_prompt(4 + i, i) for i in range(5)],
+                 max_new_tokens=3)
+    eng.close()
+    rec.close()
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    slos = [e for e in events if e["kind"] == "slo"]
+    assert slos and slos[-1]["goodput_pct"] == 0.0
+    assert any(e["kind"] == "alert" and e["rule"] == "slo_exhausted"
+               for e in events)

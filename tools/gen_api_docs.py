@@ -50,11 +50,14 @@ PAGES = {
              "apex_tpu.prof.analysis", "apex_tpu.prof.ledger",
              "apex_tpu.prof.trace_count", "apex_tpu.prof.timeline",
              "apex_tpu.prof.roofline", "apex_tpu.prof.regress",
-             "apex_tpu.prof.fleet", "apex_tpu.prof.memory"],
+             "apex_tpu.prof.fleet", "apex_tpu.prof.memory",
+             "apex_tpu.prof.requests"],
     "telemetry": ["apex_tpu.telemetry", "apex_tpu.telemetry.events",
                   "apex_tpu.telemetry.metrics",
                   "apex_tpu.telemetry.watchdog",
-                  "apex_tpu.telemetry.export"],
+                  "apex_tpu.telemetry.export",
+                  "apex_tpu.telemetry.tracing",
+                  "apex_tpu.telemetry.slo"],
     "rnn_reparam": ["apex_tpu.RNN", "apex_tpu.reparameterization"],
     "contrib": ["apex_tpu.contrib.xentropy", "apex_tpu.contrib.groupbn"],
     "models": ["apex_tpu.models"],
